@@ -1,0 +1,23 @@
+"""raydp_tpu — a TPU-native data + AI pipeline framework.
+
+Capability parity target: pang-wu/raydp ("Spark on Ray"). Where the reference runs
+Spark executors as Ray actors and trains through Ray Train / torch.distributed
+(reference: python/raydp/__init__.py:18-22, context.py:182-254), this framework runs
+an Arrow-native distributed ETL engine and JAX/XLA TPU training on one built-in actor
+runtime, exchanging data as Arrow record batches through a shared-memory object store
+and feeding device-sharded ``jax.Array``s over a ``jax.sharding.Mesh``.
+
+Public surface (mirrors the reference's ``raydp.init_spark`` / ``raydp.stop_spark``):
+
+    import raydp_tpu
+    session = raydp_tpu.init(app_name="nyc", num_executors=2,
+                             executor_cores=1, executor_memory="1GB")
+    df = session.read.csv("data.csv")
+    ds = raydp_tpu.data.from_frame_recoverable(df)
+"""
+
+__version__ = "0.1.0"
+
+from raydp_tpu.context import init, stop, active_session
+
+__all__ = ["init", "stop", "active_session", "__version__"]

@@ -1,0 +1,156 @@
+"""Session lifecycle: ``raydp_tpu.init`` / ``raydp_tpu.stop``.
+
+Parity with the reference's ``raydp.init_spark`` / ``raydp.stop_spark``
+(context.py:182-254): a lock-guarded global singleton context, placement-group
+pre-allocation of one ``{CPU, memory}`` bundle per executor, ordered teardown, and
+``atexit`` cleanup (context.py:257). Instead of launching a JVM gateway and a Spark
+driver, ``init`` boots the built-in actor runtime, creates the ETL master actor, and
+gang-starts executor actors; the returned :class:`~raydp_tpu.etl.session.Session` is
+the DataFrame entry point (the SparkSession analogue).
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Dict, List, Optional, Union
+
+from raydp_tpu import config as cfg
+from raydp_tpu.config import Config
+from raydp_tpu.log import get_logger
+from raydp_tpu.utils import parse_memory_size
+
+logger = get_logger("context")
+
+_context_lock = threading.RLock()
+_global_context: Optional["_Context"] = None
+
+
+class _Context:
+    """Holds the runtime + ETL session for one ``init()``...``stop()`` span."""
+
+    def __init__(
+        self,
+        app_name: str,
+        num_executors: int,
+        executor_cores: int,
+        executor_memory: Union[str, int],
+        placement_group_strategy: Optional[str],
+        configs: Optional[Dict[str, str]],
+        virtual_nodes: Optional[List[Dict[str, float]]],
+    ):
+        self.app_name = app_name
+        self.num_executors = num_executors
+        self.executor_cores = executor_cores
+        self.executor_memory = parse_memory_size(executor_memory)
+        self.placement_group_strategy = placement_group_strategy
+        self.config = Config(configs)
+        self.virtual_nodes = virtual_nodes
+        self.session = None
+        self._placement_group = None
+
+    def get_or_create_session(self):
+        if self.session is not None:
+            return self.session
+        from raydp_tpu.etl.session import Session
+        from raydp_tpu.runtime import init_runtime
+
+        runtime = init_runtime(config=self.config, virtual_nodes=self.virtual_nodes)
+
+        if self.placement_group_strategy is not None:
+            # one {CPU, memory} bundle per executor (parity: context.py:119-140)
+            bundles = [
+                {"CPU": float(self.executor_cores), "memory": float(self.executor_memory)}
+                for _ in range(self.num_executors)
+            ]
+            group = runtime.resource_manager.create_group(
+                bundles, self.placement_group_strategy)
+            self._placement_group = group
+            self.config.set(cfg.PLACEMENT_GROUP_KEY, group.group_id)
+            self.config.set(
+                cfg.PLACEMENT_GROUP_BUNDLE_INDEXES_KEY,
+                ",".join(str(b.index) for b in group.bundles),
+            )
+
+        self.session = Session(
+            app_name=self.app_name,
+            num_executors=self.num_executors,
+            executor_cores=self.executor_cores,
+            executor_memory=self.executor_memory,
+            config=self.config,
+            placement_group=self._placement_group,
+        )
+        self.session.start()
+        return self.session
+
+    def stop(self, cleanup_data: bool = True) -> None:
+        """Teardown order parity (context.py:152-169): master shutdown → session
+        stop → remove placement group → runtime shutdown (unless data is kept)."""
+        from raydp_tpu.runtime import get_runtime, runtime_initialized, shutdown_runtime
+
+        if self.session is not None:
+            self.session.stop(cleanup_data=cleanup_data)
+            self.session = None
+        if runtime_initialized():
+            if self._placement_group is not None:
+                get_runtime().resource_manager.remove_group(
+                    self._placement_group.group_id)
+                self._placement_group = None
+            if cleanup_data:
+                shutdown_runtime()
+
+
+def init(
+    app_name: str,
+    num_executors: int = 1,
+    executor_cores: int = 1,
+    executor_memory: Union[str, int] = "1GB",
+    placement_group_strategy: Optional[str] = None,
+    configs: Optional[Dict[str, str]] = None,
+    virtual_nodes: Optional[List[Dict[str, float]]] = None,
+):
+    """Start the framework and return the ETL :class:`Session`.
+
+    Signature parity with ``raydp.init_spark`` (context.py:182-254). Extra,
+    TPU-build-specific knob: ``virtual_nodes`` registers logical nodes to simulate
+    a multi-host topology in tests (the reference's tests get this from
+    ``ray.cluster_utils.Cluster``, test_spark_cluster.py:90-110).
+    """
+    global _global_context
+    with _context_lock:
+        if _global_context is not None:
+            raise RuntimeError("raydp_tpu is already initialized; call stop() first")
+        try:
+            _global_context = _Context(
+                app_name, num_executors, executor_cores, executor_memory,
+                placement_group_strategy, configs, virtual_nodes)
+            return _global_context.get_or_create_session()
+        except BaseException:
+            if _global_context is not None:
+                try:
+                    _global_context.stop()
+                finally:
+                    _global_context = None
+            raise
+
+
+def stop(cleanup_data: bool = True) -> None:
+    """Stop the session. With ``cleanup_data=False`` the object store (and any
+    datasets whose ownership was transferred to the master) survives, parity with
+    ``stop_spark(cleanup_data=False)`` (context.py:152-162, dataset.py:146-158)."""
+    global _global_context
+    with _context_lock:
+        if _global_context is not None:
+            try:
+                _global_context.stop(cleanup_data)
+            finally:
+                if cleanup_data:
+                    _global_context = None
+
+
+def active_session():
+    with _context_lock:
+        return _global_context.session if _global_context is not None else None
+
+
+atexit.register(stop)  # parity: context.py:257

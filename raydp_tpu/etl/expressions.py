@@ -1,0 +1,386 @@
+"""Column expressions compiled to ``pyarrow.compute`` kernels.
+
+The surface mirrors the PySpark ``Column`` algebra the reference's examples lean on
+(examples/data_process.py builds features with ``col`` arithmetic, comparisons,
+casts and date functions). Expressions are small picklable trees; executors
+evaluate them against an Arrow table partition with vectorized kernels — on the
+CPU side of the pipeline there is no MXU to feed, so the win is staying columnar
+and zero-copy end to end.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+
+class Expr:
+    """Base expression node. Subclasses must implement ``evaluate`` and ``_name``."""
+
+    def evaluate(self, table: pa.Table):
+        raise NotImplementedError
+
+    def _name(self) -> str:
+        raise NotImplementedError
+
+    # -- naming ---------------------------------------------------------------
+    def alias(self, name: str) -> "Expr":
+        return Alias(self, name)
+
+    # -- arithmetic -----------------------------------------------------------
+    def __add__(self, other):
+        return BinaryOp("add", self, _wrap(other))
+
+    def __radd__(self, other):
+        return BinaryOp("add", _wrap(other), self)
+
+    def __sub__(self, other):
+        return BinaryOp("subtract", self, _wrap(other))
+
+    def __rsub__(self, other):
+        return BinaryOp("subtract", _wrap(other), self)
+
+    def __mul__(self, other):
+        return BinaryOp("multiply", self, _wrap(other))
+
+    def __rmul__(self, other):
+        return BinaryOp("multiply", _wrap(other), self)
+
+    def __truediv__(self, other):
+        return BinaryOp("divide", self, _wrap(other))
+
+    def __rtruediv__(self, other):
+        return BinaryOp("divide", _wrap(other), self)
+
+    def __mod__(self, other):
+        return BinaryOp("mod", self, _wrap(other))
+
+    def __neg__(self):
+        return UnaryOp("negate", self)
+
+    # -- comparisons ----------------------------------------------------------
+    def __eq__(self, other):  # noqa: A003 - expression semantics over identity
+        return BinaryOp("equal", self, _wrap(other))
+
+    def __ne__(self, other):
+        return BinaryOp("not_equal", self, _wrap(other))
+
+    def __lt__(self, other):
+        return BinaryOp("less", self, _wrap(other))
+
+    def __le__(self, other):
+        return BinaryOp("less_equal", self, _wrap(other))
+
+    def __gt__(self, other):
+        return BinaryOp("greater", self, _wrap(other))
+
+    def __ge__(self, other):
+        return BinaryOp("greater_equal", self, _wrap(other))
+
+    # -- boolean --------------------------------------------------------------
+    def __and__(self, other):
+        return BinaryOp("and_kleene", self, _wrap(other))
+
+    def __rand__(self, other):
+        return BinaryOp("and_kleene", _wrap(other), self)
+
+    def __or__(self, other):
+        return BinaryOp("or_kleene", self, _wrap(other))
+
+    def __ror__(self, other):
+        return BinaryOp("or_kleene", _wrap(other), self)
+
+    def __invert__(self):
+        return UnaryOp("invert", self)
+
+    def __hash__(self):
+        return id(self)
+
+    # -- misc helpers ---------------------------------------------------------
+    def is_null(self) -> "Expr":
+        return UnaryOp("is_null", self)
+
+    def is_not_null(self) -> "Expr":
+        return UnaryOp("is_valid", self)
+
+    def isin(self, values: Sequence) -> "Expr":
+        return IsIn(self, list(values))
+
+    def cast(self, dtype) -> "Expr":
+        return Cast(self, dtype)
+
+    def astype(self, dtype) -> "Expr":
+        return Cast(self, dtype)
+
+    def between(self, low, high) -> "Expr":
+        return (self >= low) & (self <= high)
+
+    def fill_null(self, value) -> "Expr":
+        return FillNull(self, value)
+
+    @property
+    def dt(self) -> "_DtAccessor":
+        return _DtAccessor(self)
+
+    @property
+    def str(self) -> "_StrAccessor":
+        return _StrAccessor(self)
+
+
+def _wrap(v) -> Expr:
+    return v if isinstance(v, Expr) else Literal(v)
+
+
+def _as_array(v, length: int):
+    """Broadcast a scalar evaluation result when needed."""
+    return v
+
+
+class Column(Expr):
+    def __init__(self, name: str):
+        self.name = name
+
+    def evaluate(self, table: pa.Table):
+        return table.column(self.name)
+
+    def _name(self) -> str:
+        return self.name
+
+    def __repr__(self):
+        return f"col({self.name!r})"
+
+
+class Literal(Expr):
+    def __init__(self, value: Any):
+        self.value = value
+
+    def evaluate(self, table: pa.Table):
+        return pa.scalar(self.value)
+
+    def _name(self) -> str:
+        return str(self.value)
+
+
+class Alias(Expr):
+    def __init__(self, child: Expr, name: str):
+        self.child = child
+        self.name = name
+
+    def evaluate(self, table: pa.Table):
+        return self.child.evaluate(table)
+
+    def _name(self) -> str:
+        return self.name
+
+
+class BinaryOp(Expr):
+    def __init__(self, op: str, left: Expr, right: Expr):
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def evaluate(self, table: pa.Table):
+        left = self.left.evaluate(table)
+        right = self.right.evaluate(table)
+        return getattr(pc, self.op)(left, right)
+
+    def _name(self) -> str:
+        return f"({self.left._name()} {self.op} {self.right._name()})"
+
+
+class UnaryOp(Expr):
+    def __init__(self, op: str, child: Expr):
+        self.op = op
+        self.child = child
+
+    def evaluate(self, table: pa.Table):
+        return getattr(pc, self.op)(self.child.evaluate(table))
+
+    def _name(self) -> str:
+        return f"{self.op}({self.child._name()})"
+
+
+class IsIn(Expr):
+    def __init__(self, child: Expr, values: List):
+        self.child = child
+        self.values = values
+
+    def evaluate(self, table: pa.Table):
+        return pc.is_in(self.child.evaluate(table), value_set=pa.array(self.values))
+
+    def _name(self) -> str:
+        return f"{self.child._name()} IN {self.values}"
+
+
+class Cast(Expr):
+    def __init__(self, child: Expr, dtype):
+        self.child = child
+        self.dtype = dtype
+
+    def evaluate(self, table: pa.Table):
+        return pc.cast(self.child.evaluate(table), _to_arrow_type(self.dtype),
+                       safe=False)
+
+    def _name(self) -> str:
+        return self.child._name()
+
+
+class FillNull(Expr):
+    def __init__(self, child: Expr, value):
+        self.child = child
+        self.value = value
+
+    def evaluate(self, table: pa.Table):
+        return pc.fill_null(self.child.evaluate(table), self.value)
+
+    def _name(self) -> str:
+        return self.child._name()
+
+
+class When(Expr):
+    """``when(cond, value).when(...).otherwise(default)`` conditional."""
+
+    def __init__(self, branches: List, default=None):
+        self.branches = branches
+        self.default = default
+
+    def when(self, cond: Expr, value) -> "When":
+        return When(self.branches + [(cond, _wrap(value))], self.default)
+
+    def otherwise(self, value) -> "When":
+        return When(self.branches, _wrap(value))
+
+    def evaluate(self, table: pa.Table):
+        conds = pa.table(
+            {f"c{i}": _to_bool_array(c.evaluate(table), table.num_rows)
+             for i, (c, _) in enumerate(self.branches)})
+        cases = [v.evaluate(table) for _, v in self.branches]
+        default = (self.default.evaluate(table) if self.default is not None
+                   else pa.scalar(None))
+        return pc.case_when(pc.make_struct(*conds.columns), *cases, default)
+
+    def _name(self) -> str:
+        return "CASE WHEN"
+
+
+def _to_bool_array(v, length: int):
+    if isinstance(v, pa.Scalar):
+        return pa.array([v.as_py()] * length, type=pa.bool_())
+    if isinstance(v, pa.ChunkedArray):
+        return v.combine_chunks()
+    return v
+
+
+class Func(Expr):
+    """A named pyarrow.compute function over expressions, e.g. log1p, abs."""
+
+    def __init__(self, fn: str, children: List[Expr], options=None,
+                 name: Optional[str] = None):
+        self.fn = fn
+        self.children = children
+        self.options = options
+        self.name = name
+
+    def evaluate(self, table: pa.Table):
+        args = [c.evaluate(table) for c in self.children]
+        kwargs = {"options": self.options} if self.options is not None else {}
+        return getattr(pc, self.fn)(*args, **kwargs)
+
+    def _name(self) -> str:
+        return self.name or f"{self.fn}({', '.join(c._name() for c in self.children)})"
+
+
+class _DtAccessor:
+    """Datetime component extraction (examples/data_process.py uses dayofweek,
+    hour, month etc. on pickup datetimes)."""
+
+    def __init__(self, child: Expr):
+        self._child = child
+
+    def __getattr__(self, item: str):
+        mapping = {
+            "year": "year", "month": "month", "day": "day",
+            "hour": "hour", "minute": "minute", "second": "second",
+            "dayofweek": "day_of_week", "day_of_week": "day_of_week",
+            "dayofyear": "day_of_year", "week": "iso_week",
+        }
+        if item not in mapping:
+            raise AttributeError(item)
+        return lambda: Func(mapping[item], [self._child], name=item)
+
+
+class _StrAccessor:
+    def __init__(self, child: Expr):
+        self._child = child
+
+    def lower(self):
+        return Func("utf8_lower", [self._child])
+
+    def upper(self):
+        return Func("utf8_upper", [self._child])
+
+    def strip(self):
+        return Func("utf8_trim_whitespace", [self._child])
+
+    def contains(self, pat: str):
+        import pyarrow.compute as _pc
+        return Func("match_substring", [self._child],
+                    options=_pc.MatchSubstringOptions(pat))
+
+    def startswith(self, pat: str):
+        import pyarrow.compute as _pc
+        return Func("starts_with", [self._child],
+                    options=_pc.MatchSubstringOptions(pat))
+
+
+_TYPE_ALIASES: Dict[str, Callable[[], pa.DataType]] = {
+    "int": pa.int64, "long": pa.int64, "int64": pa.int64, "int32": pa.int32,
+    "short": pa.int16, "byte": pa.int8, "float": pa.float32, "float32": pa.float32,
+    "double": pa.float64, "float64": pa.float64, "bool": pa.bool_,
+    "boolean": pa.bool_, "string": pa.string, "str": pa.string,
+    "timestamp": lambda: pa.timestamp("us"), "date": pa.date32,
+    "binary": pa.binary,
+}
+
+
+def _to_arrow_type(dtype) -> pa.DataType:
+    if isinstance(dtype, pa.DataType):
+        return dtype
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key in _TYPE_ALIASES:
+            return _TYPE_ALIASES[key]()
+    if isinstance(dtype, type) and issubclass(dtype, (int, float, bool, str)):
+        return {int: pa.int64(), float: pa.float64(), bool: pa.bool_(),
+                str: pa.string()}[dtype]
+    if isinstance(dtype, np.dtype) or (isinstance(dtype, type)
+                                       and issubclass(dtype, np.generic)):
+        return pa.from_numpy_dtype(np.dtype(dtype))
+    raise ValueError(f"unsupported dtype: {dtype!r}")
+
+
+def evaluate_to_array(expr: Expr, table: pa.Table):
+    """Evaluate and materialize to a ChunkedArray of the table's length."""
+    out = expr.evaluate(table)
+    if isinstance(out, pa.Scalar):
+        out = pa.chunked_array([pa.array([out.as_py()] * table.num_rows,
+                                         type=out.type if out.type != pa.null() else None)])
+    if isinstance(out, pa.Array):
+        out = pa.chunked_array([out])
+    return out
+
+
+# -- public constructors ------------------------------------------------------------
+def col(name: str) -> Column:
+    return Column(name)
+
+
+def lit(value: Any) -> Literal:
+    return Literal(value)
+
+
+def when(cond: Expr, value) -> When:
+    return When([(cond, _wrap(value))])

@@ -1,0 +1,363 @@
+"""Task model: the physical unit of ETL work.
+
+A :class:`Task` is a self-contained recipe for one partition — a source step plus a
+chain of transform steps — finished by an output mode (return a store ref, cache as
+a named block, hash-shuffle into buckets, collect, or count). Tasks being
+self-contained *is* the lineage mechanism: any executor can recompute any lost
+partition from the recipe, the property the reference gets from Spark RDD lineage +
+its recache RPC (ObjectStoreWriter.scala:164-204 persists and pins the Arrow RDD;
+RayDPExecutor.scala:289-310 re-caches lost blocks through the driver agent).
+
+Everything here must stay picklable and runnable inside an executor actor process.
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.csv as pacsv
+import pyarrow.parquet as pq
+
+from raydp_tpu.etl.expressions import Expr, evaluate_to_array
+from raydp_tpu.runtime.object_store import ObjectRef, get_client
+
+# -- output modes -------------------------------------------------------------------
+RETURN_REF = "return_ref"
+CACHE = "cache"
+SHUFFLE = "shuffle"
+COLLECT = "collect"
+ROWCOUNT = "rowcount"
+
+
+class Step:
+    def run(self, table: pa.Table) -> pa.Table:
+        raise NotImplementedError
+
+
+# ==== sources ======================================================================
+@dataclass
+class RangeSource(Step):
+    start: int
+    stop: int
+    step: int = 1
+    column: str = "id"
+
+    def load(self) -> pa.Table:
+        return pa.table({self.column: np.arange(self.start, self.stop, self.step)})
+
+
+@dataclass
+class CsvSliceSource(Step):
+    """Byte-range slice of a CSV file.
+
+    ``start``/``end`` are *approximate* offsets: the reader skips to the first full
+    line at/after ``start`` and reads through the line spanning ``end``. The header
+    is re-attached so every slice parses independently — this is how one big CSV
+    becomes N parallel partitions without a pre-pass.
+    """
+
+    path: str
+    start: int
+    end: int
+    header: bytes
+    parse_options: Optional[dict] = None
+
+    def load(self) -> pa.Table:
+        with open(self.path, "rb") as f:
+            if self.start > 0:
+                f.seek(self.start - 1)
+                f.readline()  # consume partial line (or the newline ending it)
+            pos = f.tell()
+            if pos >= self.end and self.start > 0:
+                data = b""
+            else:
+                data = f.read(self.end - pos)
+                # extend through the end of the line spanning `end`
+                if not data.endswith(b"\n"):
+                    data += f.readline()
+        payload = self.header + data if self.start > 0 else data
+        if not payload.strip():
+            return pacsv.read_csv(io.BytesIO(self.header))[:0]
+        opts = self.parse_options or {}
+        convert = pacsv.ConvertOptions(**opts.get("convert", {}))
+        return pacsv.read_csv(io.BytesIO(payload), convert_options=convert)
+
+
+@dataclass
+class ParquetSource(Step):
+    path: str
+    row_groups: Optional[List[int]] = None
+    columns: Optional[List[str]] = None
+
+    def load(self) -> pa.Table:
+        f = pq.ParquetFile(self.path)
+        if self.row_groups is None:
+            return f.read(columns=self.columns)
+        return f.read_row_groups(self.row_groups, columns=self.columns)
+
+
+@dataclass
+class ArrowRefSource(Step):
+    """Concatenate Arrow tables from object-store refs (zero-copy reads)."""
+
+    refs: List[ObjectRef]
+    schema: Optional[bytes] = None  # serialized schema for the 0-ref case
+
+    def load(self) -> pa.Table:
+        client = get_client()
+        tables = [client.get(r) for r in self.refs]
+        tables = [t for t in tables if t.num_rows >= 0]
+        if not tables:
+            if self.schema is not None:
+                return pa.ipc.read_schema(pa.py_buffer(self.schema)).empty_table()
+            raise ValueError("ArrowRefSource with no refs and no schema")
+        return pa.concat_tables(tables, promote_options="permissive")
+
+
+@dataclass
+class SlicedRefSource(Step):
+    """Row-range slices of store refs: ``(ref, offset, length)`` triples.
+
+    Used by the balanced sharding path (``divide_blocks``) where a rank takes only
+    part of a block (reference utils.py:149-222 returns per-block sample counts).
+    """
+
+    parts: List[Tuple[ObjectRef, int, int]]
+
+    def load(self) -> pa.Table:
+        client = get_client()
+        tables = []
+        for ref, offset, length in self.parts:
+            t = client.get(ref)
+            tables.append(t.slice(offset, length))
+        return pa.concat_tables(tables, promote_options="permissive")
+
+
+@dataclass
+class CachedSource(Step):
+    """Executor-local cached block, with a recovery recipe on miss.
+
+    Parity: BlockManager read in ``getRDDPartition`` with recache-then-retry on
+    miss (RayDPExecutor.scala:312-355). ``recover`` is the lineage task that
+    recomputes the partition from first principles.
+    """
+
+    cache_key: str
+    recover: Optional["Task"] = None
+
+    def load(self) -> pa.Table:
+        from raydp_tpu.etl.executor import current_block_cache
+        cache = current_block_cache()
+        table = cache.get(self.cache_key)
+        if table is None:
+            if self.recover is None:
+                raise KeyError(f"block {self.cache_key} lost and no lineage recipe")
+            table = run_task_body(self.recover)
+            cache.put(self.cache_key, table)
+        return table
+
+
+# ==== transforms ===================================================================
+@dataclass
+class ProjectStep(Step):
+    """Output exactly these (name, expr) columns — select / withColumn / drop."""
+
+    columns: List[Tuple[str, Expr]]
+
+    def run(self, table: pa.Table) -> pa.Table:
+        arrays, names = [], []
+        for name, expr in self.columns:
+            arrays.append(evaluate_to_array(expr, table))
+            names.append(name)
+        return pa.table(dict(zip(names, arrays)))
+
+
+@dataclass
+class FilterStep(Step):
+    predicate: Expr
+
+    def run(self, table: pa.Table) -> pa.Table:
+        mask = evaluate_to_array(self.predicate, table)
+        return table.filter(pc.fill_null(mask, False))
+
+
+@dataclass
+class DropNaStep(Step):
+    subset: Optional[List[str]] = None
+
+    def run(self, table: pa.Table) -> pa.Table:
+        cols = self.subset or table.column_names
+        mask = None
+        for c in cols:
+            valid = pc.is_valid(table.column(c))
+            mask = valid if mask is None else pc.and_(mask, valid)
+        return table.filter(mask) if mask is not None else table
+
+
+@dataclass
+class SampleStep(Step):
+    fraction: float
+    seed: Optional[int] = None
+    partition_index: int = 0
+
+    def run(self, table: pa.Table) -> pa.Table:
+        seed = (self.seed if self.seed is not None else 0) + self.partition_index
+        rng = np.random.RandomState(seed)
+        mask = rng.random_sample(table.num_rows) < self.fraction
+        return table.filter(pa.array(mask))
+
+
+@dataclass
+class SplitSelectStep(Step):
+    """Deterministic random split: keep rows whose draw lands in [lo, hi).
+
+    Powers ``random_split`` (reference utils.py:67-90): every sibling frame uses
+    the same seed with a different band, so splits are disjoint and exhaustive.
+    """
+
+    lo: float
+    hi: float
+    seed: int
+    partition_index: int = 0
+
+    def run(self, table: pa.Table) -> pa.Table:
+        rng = np.random.RandomState(self.seed + self.partition_index)
+        draws = rng.random_sample(table.num_rows)
+        return table.filter(pa.array((draws >= self.lo) & (draws < self.hi)))
+
+
+@dataclass
+class LimitStep(Step):
+    n: int
+
+    def run(self, table: pa.Table) -> pa.Table:
+        return table.slice(0, self.n)
+
+
+@dataclass
+class LocalSortStep(Step):
+    keys: List[Tuple[str, str]]  # (column, "ascending"|"descending")
+
+    def run(self, table: pa.Table) -> pa.Table:
+        return table.sort_by(self.keys)
+
+
+@dataclass
+class GroupAggStep(Step):
+    """Local hash aggregation; correct as a whole when rows were shuffled by key."""
+
+    keys: List[str]
+    aggs: List[Tuple[str, str, str]]  # (input_col, agg_fn, output_name)
+
+    def run(self, table: pa.Table) -> pa.Table:
+        agg_spec = [(c, f) for c, f, _ in self.aggs]
+        out = table.group_by(self.keys).aggregate(agg_spec)
+        # rename pyarrow's <col>_<fn> outputs to requested names
+        rename = {}
+        for c, f, name in self.aggs:
+            rename[f"{c}_{f}"] = name
+        new_names = [rename.get(n, n) for n in out.column_names]
+        return out.rename_columns(new_names)
+
+
+@dataclass
+class HashJoinStep(Step):
+    """Join the incoming (left bucket) table against the right bucket refs."""
+
+    right_refs: List[ObjectRef]
+    keys: List[str]
+    right_keys: List[str]
+    how: str = "inner"
+    right_schema: Optional[bytes] = None
+
+    def run(self, table: pa.Table) -> pa.Table:
+        right = ArrowRefSource(self.right_refs, schema=self.right_schema).load()
+        return table.join(right, keys=self.keys, right_keys=self.right_keys,
+                          join_type=self.how)
+
+
+@dataclass
+class RenameStep(Step):
+    mapping: Dict[str, str]
+
+    def run(self, table: pa.Table) -> pa.Table:
+        return table.rename_columns(
+            [self.mapping.get(c, c) for c in table.column_names])
+
+
+# ==== task =========================================================================
+@dataclass
+class Task:
+    task_id: str
+    source: Step
+    steps: List[Step] = field(default_factory=list)
+    output: str = RETURN_REF
+    # SHUFFLE parameters
+    num_buckets: int = 0
+    shuffle_keys: Optional[List[str]] = None      # None → round-robin repartition
+    # CACHE parameter
+    cache_key: Optional[str] = None
+    # range-partition boundaries for sort (overrides hash bucketing)
+    range_key: Optional[Tuple[str, List]] = None
+    owner: Optional[str] = None                   # object-store owner for outputs
+
+    def with_output(self, **kw) -> "Task":
+        d = self.__dict__.copy()
+        d.update(kw)
+        return Task(**d)
+
+
+def run_task_body(task: Task) -> pa.Table:
+    src = task.source
+    table = src.load()
+    for step in task.steps:
+        table = step.run(table)
+    return table
+
+
+def hash_buckets(table: pa.Table, keys: Sequence[str], num_buckets: int) -> List[pa.Table]:
+    """Deterministic hash partitioning on key columns.
+
+    Uses a stable numpy-side hash over the key columns so map tasks on different
+    executors agree — Python's ``hash`` is salted per process and unusable here.
+    """
+    if table.num_rows == 0:
+        return [table] * num_buckets
+    acc = np.zeros(table.num_rows, dtype=np.uint64)
+    for k in keys:
+        arr = table.column(k).combine_chunks()
+        if pa.types.is_integer(arr.type) or pa.types.is_floating(arr.type):
+            vals = np.asarray(pc.cast(arr, pa.float64(), safe=False).fill_null(np.nan))
+            h = vals.view(np.uint64).copy()
+        else:
+            h = np.array([hash_bytes(str(v)) for v in arr.to_pylist()],
+                         dtype=np.uint64)
+        acc = acc * np.uint64(1000003) + h
+    bucket = (acc % np.uint64(num_buckets)).astype(np.int64)
+    return [table.filter(pa.array(bucket == b)) for b in range(num_buckets)]
+
+
+def hash_bytes(s: str) -> int:
+    import zlib
+    return zlib.crc32(s.encode()) & 0xFFFFFFFF
+
+
+def round_robin_buckets(table: pa.Table, num_buckets: int,
+                        start: int = 0) -> List[pa.Table]:
+    if table.num_rows == 0:
+        return [table] * num_buckets
+    idx = (np.arange(table.num_rows) + start) % num_buckets
+    return [table.filter(pa.array(idx == b)) for b in range(num_buckets)]
+
+
+def range_buckets(table: pa.Table, key: str, boundaries: List) -> List[pa.Table]:
+    col_arr = table.column(key).combine_chunks()
+    vals = np.asarray(pc.cast(col_arr, pa.float64(), safe=False))
+    edges = np.array(boundaries, dtype=np.float64)
+    bucket = np.searchsorted(edges, vals, side="right")
+    return [table.filter(pa.array(bucket == b)) for b in range(len(boundaries) + 1)]

@@ -1,0 +1,40 @@
+"""raydp_tpu.runtime — the built-in actor runtime substrate.
+
+The reference delegates its substrate to Ray core: actors, named-actor lookup,
+placement groups, the plasma shared-memory object store, cross-language calls, and
+actor restart (SURVEY.md §1 L1; reference RayExecutorUtils.java:37-62 configures
+``maxRestarts=-1`` executor actors). This package provides the same primitives
+natively, designed for the TPU process model (one JAX process owns a host's chips,
+so placement is host-granular):
+
+- :mod:`rpc` — length-prefixed cloudpickle request/response over TCP.
+- :mod:`object_store` — shared-memory Arrow object store with ownership + refcounts.
+- :mod:`actor` — actor processes, handles, named lookup, restart protocol.
+- :mod:`head` — driver-side control plane: registry, nodes, placement groups.
+"""
+
+from raydp_tpu.runtime.head import (
+    RuntimeContext,
+    init_runtime,
+    shutdown_runtime,
+    get_runtime,
+    runtime_initialized,
+)
+from raydp_tpu.runtime.actor import ActorHandle, actor_context, current_actor_context
+from raydp_tpu.runtime.object_store import ObjectRef, ObjectStoreClient
+from raydp_tpu.runtime.placement import PlacementGroup, PlacementStrategy
+
+__all__ = [
+    "RuntimeContext",
+    "init_runtime",
+    "shutdown_runtime",
+    "get_runtime",
+    "runtime_initialized",
+    "ActorHandle",
+    "actor_context",
+    "current_actor_context",
+    "ObjectRef",
+    "ObjectStoreClient",
+    "PlacementGroup",
+    "PlacementStrategy",
+]

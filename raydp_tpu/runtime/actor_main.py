@@ -1,0 +1,119 @@
+"""Actor process bootstrap (``python -m raydp_tpu.runtime.actor_main``).
+
+The spawn handshake mirrors the reference's conn-info protocol — the reference
+launches its gateway JVM and reads the bound port back through a temp file
+(ray_cluster_master.py:103-183, AppMasterEntryPoint.scala:50-94); here the child
+instead reports its bound RPC address to the head over the head's own RPC channel
+and fetches its cloudpickled spec. Like the reference's entry point, the process
+must die with its supervisor: we watch the head connection and exit when it drops
+(AppMasterEntryPoint.scala exits on stdin EOF).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+import cloudpickle
+
+from raydp_tpu.log import init_logging
+from raydp_tpu.runtime import object_store as objstore
+from raydp_tpu.runtime.actor import ActorContext, actor_context
+from raydp_tpu.runtime.head import ENV_ACTOR_ID, ENV_HEAD, ENV_SESSION, ENV_SESSION_DIR
+from raydp_tpu.runtime.object_store import ObjectStoreClient
+from raydp_tpu.runtime.rpc import MethodDispatcher, RpcClient, RpcServer
+
+
+class StoreTableProxy:
+    """Forwards ObjectStoreServer's table methods to the head over RPC."""
+
+    def __init__(self, head: RpcClient):
+        self._head = head
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        method = f"store_{item}"
+
+        def _call(*args):
+            return self._head.call(method, *args)
+
+        return _call
+
+
+class _ActorServer:
+    """Wraps the user object: exposes its public methods plus runtime intrinsics."""
+
+    def __init__(self, instance):
+        self._instance = instance
+        self._dispatch = MethodDispatcher(instance)
+
+    def __call__(self, method: str, args: tuple, kwargs: dict):
+        if method == "__rdt_ping__":
+            return "pong"
+        if method == "__rdt_shutdown__":
+            threading.Thread(target=_delayed_exit, daemon=True).start()
+            return True
+        return self._dispatch(method, args, kwargs)
+
+
+def _delayed_exit():
+    time.sleep(0.2)
+    os._exit(0)
+
+
+def main() -> None:
+    head_url = os.environ[ENV_HEAD]
+    actor_id = os.environ[ENV_ACTOR_ID]
+    session_id = os.environ[ENV_SESSION]
+    session_dir = os.environ.get(ENV_SESSION_DIR, "/tmp/raydp_tpu")
+
+    host, port = head_url.rsplit(":", 1)
+    head = RpcClient((host, int(port)))
+    spec = head.call("fetch_actor_spec", actor_id)
+
+    name = spec["name"]
+    role = name or actor_id
+    init_logging(role, spec.get("log_level", "INFO"),
+                 os.path.join(session_dir, "logs"), session_id)
+
+    store = ObjectStoreClient(StoreTableProxy(head), session_id,
+                              default_owner=name or actor_id)
+    objstore.set_client(store)
+
+    ctx = ActorContext(
+        actor_id=actor_id,
+        name=name,
+        node_id=spec["node_id"],
+        was_restarted=spec["was_restarted"],
+        restart_count=spec["restart_count"],
+        head_client=head,
+        session_id=session_id,
+    )
+    actor_context(ctx)
+
+    cls = cloudpickle.loads(spec["cls_bytes"])
+    args, kwargs = cloudpickle.loads(spec["args_bytes"])
+    instance = cls(*args, **kwargs)
+
+    server = RpcServer(_ActorServer(instance), host="127.0.0.1", port=0,
+                       max_concurrency=max(2, int(spec["max_concurrency"])),
+                       name=role)
+    head.call("actor_ready", actor_id, server.address[0], server.address[1])
+
+    # die with the head: if the driver goes away, so do we
+    try:
+        while True:
+            head.call("ping", timeout=30.0)
+            time.sleep(5.0)
+    except Exception:
+        pass
+    finally:
+        server.stop()
+        os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,511 @@
+"""The head: driver-side control plane of the actor runtime.
+
+This is the GCS-of-one-process that replaces what the reference gets from Ray's
+head services: the named-actor registry, actor supervision/restart, node + resource
+accounting, placement groups, and the object-store table (SURVEY.md §1 L1). It runs
+as threads inside the driver process; actor processes talk to it over one RPC
+connection (address handed down via environment).
+
+Supervision parity: executor actors are created with ``max_restarts=-1`` and revived
+on crash (RayExecutorUtils.java:58-59); deliberate kills do not revive
+(ApplicationInfo.scala:119-130); a dead owner's objects are swept from the store
+unless ownership was transferred (dataset.py:137-158).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from raydp_tpu import config as cfg
+from raydp_tpu.config import Config
+from raydp_tpu.log import get_logger, init_logging
+from raydp_tpu.runtime import object_store as objstore
+from raydp_tpu.runtime.actor import (
+    ALIVE, DEAD, PENDING, RESTARTING, ActorHandle, ActorSpec, dump_spec,
+)
+from raydp_tpu.runtime.object_store import ObjectStoreClient, ObjectStoreServer
+from raydp_tpu.runtime.placement import PlacementGroup, PlacementStrategy, ResourceManager
+from raydp_tpu.runtime.rpc import MethodDispatcher, RpcServer
+
+logger = get_logger("head")
+
+ENV_HEAD = "RAYDP_TPU_HEAD"
+ENV_ACTOR_ID = "RAYDP_TPU_ACTOR_ID"
+ENV_SESSION = "RAYDP_TPU_SESSION"
+ENV_SESSION_DIR = "RAYDP_TPU_SESSION_DIR"
+
+
+@dataclass
+class ActorRecord:
+    spec: ActorSpec
+    state: str = PENDING
+    process: Optional[subprocess.Popen] = None
+    address: Optional[tuple] = None
+    node_id: Optional[str] = None
+    restart_count: int = 0
+    was_restarted: bool = False
+    deliberate_kill: bool = False
+    ready: threading.Event = field(default_factory=threading.Event)
+    resources_held: Dict[str, float] = field(default_factory=dict)
+
+
+class HeadService:
+    """RPC surface of the head. One instance serves driver helpers and all actors."""
+
+    def __init__(self, runtime: "RuntimeContext"):
+        self._rt = runtime
+
+    # ---- object store table (proxied verbatim) ------------------------------
+    def store_seal(self, *a):
+        return self._rt.store_server.seal(*a)
+
+    def store_lookup(self, *a):
+        return self._rt.store_server.lookup(*a)
+
+    def store_contains(self, *a):
+        return self._rt.store_server.contains(*a)
+
+    def store_add_ref(self, *a):
+        return self._rt.store_server.add_ref(*a)
+
+    def store_remove_ref(self, *a):
+        return self._rt.store_server.remove_ref(*a)
+
+    def store_free(self, *a):
+        return self._rt.store_server.free(*a)
+
+    def store_transfer_ownership(self, *a):
+        return self._rt.store_server.transfer_ownership(*a)
+
+    def store_free_owned_by(self, *a):
+        return self._rt.store_server.free_owned_by(*a)
+
+    def store_stats(self, *a):
+        return self._rt.store_server.stats(*a)
+
+    def store_owned_by(self, *a):
+        return self._rt.store_server.owned_by(*a)
+
+    # ---- actor lifecycle ----------------------------------------------------
+    def fetch_actor_spec(self, actor_id: str) -> Dict[str, Any]:
+        rec = self._rt.record(actor_id)
+        return {
+            "cls_bytes": rec.spec.cls_bytes,
+            "args_bytes": rec.spec.args_bytes,
+            "name": rec.spec.name,
+            "max_concurrency": rec.spec.max_concurrency,
+            "node_id": rec.node_id,
+            "was_restarted": rec.was_restarted,
+            "restart_count": rec.restart_count,
+            "session_id": self._rt.session_id,
+            "session_dir": self._rt.session_dir,
+            "log_level": self._rt.config.get(cfg.LOG_LEVEL_KEY, "INFO"),
+        }
+
+    def actor_ready(self, actor_id: str, host: str, port: int) -> None:
+        self._rt.on_actor_ready(actor_id, (host, port))
+
+    def get_actor_address(self, actor_id: str) -> Optional[tuple]:
+        rec = self._rt.records.get(actor_id)
+        if rec is None or rec.state == DEAD:
+            return None
+        if not rec.ready.is_set():
+            # brief grace for restarts in flight
+            rec.ready.wait(timeout=60.0)
+        return rec.address if rec.ready.is_set() else None
+
+    def get_actor_state(self, actor_id: str) -> str:
+        rec = self._rt.records.get(actor_id)
+        return rec.state if rec else DEAD
+
+    def wait_actor_ready(self, actor_id: str, timeout: float) -> bool:
+        rec = self._rt.record(actor_id)
+        if not rec.ready.wait(timeout=timeout):
+            raise TimeoutError(
+                f"actor {rec.spec.name or actor_id} not ready after {timeout}s "
+                f"(state={rec.state})")
+        return True
+
+    def get_named_actor(self, name: str) -> Optional[str]:
+        return self._rt.names.get(name)
+
+    def create_actor(self, spec_fields: Dict[str, Any], block: bool = False) -> str:
+        spec = ActorSpec(**spec_fields)
+        handle = self._rt.launch_actor(spec, block=block)
+        return handle.actor_id
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        self._rt.kill_actor(actor_id, no_restart)
+
+    def list_actors(self) -> List[Dict[str, Any]]:
+        out = []
+        for aid, rec in self._rt.records.items():
+            out.append({
+                "actor_id": aid, "name": rec.spec.name, "state": rec.state,
+                "node_id": rec.node_id, "restart_count": rec.restart_count,
+                "resources": rec.spec.resources,
+            })
+        return out
+
+    # ---- nodes / resources / placement --------------------------------------
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        return [
+            {"node_id": n.node_id, "address": n.address, "alive": n.alive,
+             "resources": dict(n.resources), "available": dict(n.available)}
+            for n in self._rt.resource_manager.nodes()
+        ]
+
+    def add_node(self, resources: Dict[str, float], address: Optional[str] = None) -> str:
+        return self._rt.resource_manager.add_node(address or "127.0.0.1", resources)
+
+    def remove_node(self, node_id: str) -> None:
+        self._rt.remove_node(node_id)
+
+    def create_placement_group(self, bundles: List[Dict[str, float]],
+                               strategy: str) -> Dict[str, Any]:
+        group = self._rt.resource_manager.create_group(
+            bundles, PlacementStrategy(strategy))
+        return _group_to_dict(group)
+
+    def remove_placement_group(self, group_id: str) -> None:
+        self._rt.resource_manager.remove_group(group_id)
+
+    def get_placement_group(self, group_id: str) -> Optional[Dict[str, Any]]:
+        group = self._rt.resource_manager.get_group(group_id)
+        return _group_to_dict(group) if group else None
+
+    def list_placement_groups(self) -> List[Dict[str, Any]]:
+        return [_group_to_dict(g) for g in self._rt.resource_manager.groups()]
+
+    def ping(self) -> str:
+        return "pong"
+
+
+def _group_to_dict(group: PlacementGroup) -> Dict[str, Any]:
+    return {
+        "group_id": group.group_id,
+        "strategy": group.strategy.value,
+        "bundles": [
+            {"index": b.index, "resources": b.resources, "node_id": b.node_id}
+            for b in group.bundles
+        ],
+    }
+
+
+class RuntimeContext:
+    """Singleton runtime: head services + supervisor + driver-side store client."""
+
+    def __init__(self, config: Optional[Config] = None,
+                 virtual_nodes: Optional[List[Dict[str, float]]] = None):
+        self.config = config or Config()
+        self.session_id = uuid.uuid4().hex
+        self.session_dir = os.path.join(
+            "/tmp", "raydp_tpu", f"session_{self.session_id[:12]}")
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        init_logging("driver", self.config.get(cfg.LOG_LEVEL_KEY, "INFO"),
+                     os.path.join(self.session_dir, "logs"), self.session_id)
+
+        self.store_server = ObjectStoreServer(self.session_id)
+        self.resource_manager = ResourceManager()
+        if virtual_nodes:
+            for res in virtual_nodes:
+                self.resource_manager.add_node("127.0.0.1", res)
+        else:
+            self.resource_manager.add_node("127.0.0.1", _default_node_resources())
+
+        self.records: Dict[str, ActorRecord] = {}
+        self.names: Dict[str, str] = {}
+        self._lock = threading.RLock()
+        self._stopped = threading.Event()
+
+        self.service = HeadService(self)
+        self.server = RpcServer(MethodDispatcher(self.service), max_concurrency=16,
+                                name="head")
+        self.store_client = ObjectStoreClient(self.store_server, self.session_id,
+                                              default_owner=objstore.DRIVER_OWNER)
+        objstore.set_client(self.store_client)
+
+        self._supervisor = threading.Thread(target=self._supervise, daemon=True,
+                                            name="actor-supervisor")
+        self._supervisor.start()
+        logger.info("runtime head started at %s (session %s)",
+                    self.server.url, self.session_id[:12])
+
+    # ---- actor management ---------------------------------------------------
+    def record(self, actor_id: str) -> ActorRecord:
+        rec = self.records.get(actor_id)
+        if rec is None:
+            raise KeyError(f"unknown actor {actor_id}")
+        return rec
+
+    def create_actor(
+        self,
+        cls,
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        *,
+        name: Optional[str] = None,
+        resources: Optional[Dict[str, float]] = None,
+        max_restarts: int = 0,
+        max_concurrency: int = 2,
+        env: Optional[Dict[str, str]] = None,
+        node_id: Optional[str] = None,
+        placement_group: Optional[str] = None,
+        bundle_index: Optional[int] = None,
+        block: bool = True,
+    ) -> ActorHandle:
+        cls_bytes, args_bytes = dump_spec(cls, args, kwargs or {})
+        spec = ActorSpec(
+            actor_id=f"actor-{uuid.uuid4().hex[:12]}",
+            name=name,
+            cls_bytes=cls_bytes,
+            args_bytes=args_bytes,
+            resources=dict(resources or {}),
+            max_restarts=max_restarts,
+            max_concurrency=max_concurrency,
+            env=dict(env or {}),
+            node_id=node_id,
+            placement_group_id=placement_group,
+            bundle_index=bundle_index,
+        )
+        return self.launch_actor(spec, block=block)
+
+    def launch_actor(self, spec: ActorSpec, block: bool = True) -> ActorHandle:
+        with self._lock:
+            if spec.name is not None and spec.name in self.names:
+                existing = self.records.get(self.names[spec.name])
+                if existing is not None and existing.state != DEAD:
+                    raise ValueError(f"actor name {spec.name!r} already taken")
+            pinned_node = spec.node_id
+            if spec.placement_group_id is not None and spec.bundle_index is not None:
+                group = self.resource_manager.get_group(spec.placement_group_id)
+                if group is None:
+                    raise ValueError(f"unknown placement group {spec.placement_group_id}")
+                pinned_node = group.bundle_node(spec.bundle_index)
+            node_id = self.resource_manager.allocate(spec.resources, pinned_node)
+            if node_id is None and spec.placement_group_id is not None:
+                # bundle resources were pre-reserved by the group: run there without
+                # double-charging the node (parity: actors scheduled *into* bundles)
+                node_id = pinned_node
+                held: Dict[str, float] = {}
+            elif node_id is None:
+                raise ValueError(
+                    f"cannot place actor {spec.name or spec.actor_id}: "
+                    f"resources {spec.resources} not available")
+            else:
+                held = dict(spec.resources)
+            rec = ActorRecord(spec=spec, node_id=node_id, resources_held=held)
+            self.records[spec.actor_id] = rec
+            if spec.name is not None:
+                self.names[spec.name] = spec.actor_id
+            self._spawn(rec)
+        handle = ActorHandle(spec.actor_id, spec.name, self.server.address)
+        if block:
+            handle.wait_ready()
+        return handle
+
+    def _spawn(self, rec: ActorRecord) -> None:
+        env = dict(os.environ)
+        env.update(rec.spec.env)
+        env[ENV_HEAD] = self.server.url
+        env[ENV_ACTOR_ID] = rec.spec.actor_id
+        env[ENV_SESSION] = self.session_id
+        env[ENV_SESSION_DIR] = self.session_dir
+        # child must resolve every module the driver can (cloudpickle pickles
+        # classes by reference): prepend the driver's sys.path
+        driver_path = [p for p in sys.path if p]
+        existing = env.get("PYTHONPATH")
+        if existing:
+            driver_path.append(existing)
+        env["PYTHONPATH"] = os.pathsep.join(driver_path)
+        log_path = os.path.join(
+            self.session_dir, "logs",
+            f"{rec.spec.name or rec.spec.actor_id}-r{rec.restart_count}.out")
+        out = open(log_path, "ab")
+        rec.process = subprocess.Popen(
+            [sys.executable, "-m", "raydp_tpu.runtime.actor_main"],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        out.close()
+        rec.state = PENDING if rec.restart_count == 0 else RESTARTING
+
+    def on_actor_ready(self, actor_id: str, address: tuple) -> None:
+        rec = self.record(actor_id)
+        rec.address = tuple(address)
+        rec.state = ALIVE
+        rec.ready.set()
+        logger.info("actor %s ready at %s (restart %d)",
+                    rec.spec.name or actor_id, address, rec.restart_count)
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        with self._lock:
+            rec = self.records.get(actor_id)
+            if rec is None:
+                return
+            rec.deliberate_kill = no_restart
+            proc = rec.process
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    proc.kill()
+                except ProcessLookupError:
+                    pass
+        # supervisor loop will observe the exit and apply restart-vs-dead policy
+
+    def owner_key(self, rec: ActorRecord) -> str:
+        return rec.spec.name or rec.spec.actor_id
+
+    def _supervise(self) -> None:
+        while not self._stopped.is_set():
+            with self._lock:
+                items = list(self.records.items())
+            for actor_id, rec in items:
+                if rec.state == DEAD or rec.process is None:
+                    continue
+                code = rec.process.poll()
+                if code is None:
+                    continue
+                with self._lock:
+                    if rec.state == DEAD:
+                        continue
+                    rec.ready.clear()
+                    rec.address = None
+                    if rec.node_id and rec.resources_held:
+                        self.resource_manager.release(rec.node_id, rec.resources_held)
+                        rec.resources_held = {}
+                    limit = rec.spec.max_restarts
+                    can_restart = (not rec.deliberate_kill
+                                   and (limit == -1 or rec.restart_count < limit))
+                    if can_restart:
+                        rec.restart_count += 1
+                        rec.was_restarted = True
+                        rec.state = RESTARTING
+                        node_id = self.resource_manager.allocate(
+                            rec.spec.resources, rec.spec.node_id)
+                        if node_id is None:
+                            # leave RESTARTING: retried next tick (pending resources)
+                            rec.process = None
+                            continue
+                        rec.node_id = node_id
+                        rec.resources_held = dict(rec.spec.resources)
+                        logger.warning(
+                            "actor %s exited with code %s; restarting (attempt %d)",
+                            rec.spec.name or actor_id, code, rec.restart_count)
+                        self._spawn(rec)
+                    else:
+                        rec.state = DEAD
+                        rec.process = None
+                        logger.info("actor %s exited with code %s; dead",
+                                    rec.spec.name or actor_id, code)
+                        self.store_server.free_owned_by(self.owner_key(rec))
+            # pending RESTARTING actors with no process: retry placement
+            with self._lock:
+                for rec in self.records.values():
+                    if rec.state == RESTARTING and rec.process is None:
+                        node_id = self.resource_manager.allocate(
+                            rec.spec.resources, rec.spec.node_id)
+                        if node_id is not None:
+                            rec.node_id = node_id
+                            rec.resources_held = dict(rec.spec.resources)
+                            self._spawn(rec)
+            time.sleep(0.1)
+
+    # ---- nodes --------------------------------------------------------------
+    def remove_node(self, node_id: str) -> None:
+        """Fault injection: node death kills its actors; restartable actors are
+        revived on surviving nodes (parity: test_spark_cluster.py:262-299)."""
+        self.resource_manager.remove_node(node_id)
+        with self._lock:
+            victims = [rec for rec in self.records.values()
+                       if rec.node_id == node_id and rec.state != DEAD]
+        for rec in victims:
+            rec.spec.node_id = None  # allow re-placement anywhere
+            self.kill_actor(rec.spec.actor_id, no_restart=False)
+
+    def get_actor(self, name: str) -> Optional[ActorHandle]:
+        actor_id = self.names.get(name)
+        if actor_id is None:
+            return None
+        rec = self.records.get(actor_id)
+        if rec is None or rec.state == DEAD:
+            return None
+        return ActorHandle(actor_id, name, self.server.address)
+
+    # ---- shutdown -----------------------------------------------------------
+    def shutdown(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        with self._lock:
+            recs = list(self.records.values())
+        for rec in recs:
+            rec.deliberate_kill = True
+            if rec.process is not None and rec.process.poll() is None:
+                try:
+                    os.killpg(rec.process.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    try:
+                        rec.process.kill()
+                    except ProcessLookupError:
+                        pass
+            rec.state = DEAD
+        self.store_client.close()
+        self.store_server.shutdown()
+        self.server.stop()
+        objstore.set_client(None)
+        logger.info("runtime head shut down (session %s)", self.session_id[:12])
+
+
+def _default_node_resources() -> Dict[str, float]:
+    try:
+        import psutil
+        mem = int(psutil.virtual_memory().total * 0.8)
+    except Exception:
+        mem = 8 << 30
+    cpus = float(os.cpu_count() or 1)
+    return {"CPU": max(cpus, 4.0), "memory": float(mem)}
+
+
+# -- module-global singleton --------------------------------------------------------
+_runtime: Optional[RuntimeContext] = None
+_runtime_lock = threading.RLock()
+
+
+def init_runtime(config: Optional[Config] = None,
+                 virtual_nodes: Optional[List[Dict[str, float]]] = None) -> RuntimeContext:
+    global _runtime
+    with _runtime_lock:
+        if _runtime is None:
+            _runtime = RuntimeContext(config=config, virtual_nodes=virtual_nodes)
+        return _runtime
+
+
+def get_runtime() -> RuntimeContext:
+    if _runtime is None:
+        raise RuntimeError("runtime not initialized; call raydp_tpu.init() first")
+    return _runtime
+
+
+def runtime_initialized() -> bool:
+    return _runtime is not None
+
+
+def shutdown_runtime() -> None:
+    global _runtime
+    with _runtime_lock:
+        if _runtime is not None:
+            _runtime.shutdown()
+            _runtime = None

@@ -1,0 +1,334 @@
+"""Shared-memory object store: the plasma-equivalent data plane.
+
+The reference's data plane is Ray's plasma store: Spark executors serialize Arrow IPC
+partitions into shared memory, Python training workers map them zero-copy, and an
+ownership/refcount protocol decides lifetime (SURVEY.md §2.5; reference
+RayDPUtils.java:45-53 ``readBinary`` is the zero-copy handoff kernel;
+dataset.py:137-158 transfers object ownership to the master actor so data outlives
+Spark). This module provides the native equivalent:
+
+- every object is one POSIX shared-memory segment (``/dev/shm``), written once and
+  sealed; readers attach and get a zero-copy ``memoryview``;
+- a metadata server (thread in the head process) keeps the object table:
+  ``id -> (segment, size, kind, owner, refcount)``;
+- objects are *owned*: when their owning actor dies un-transferred, they are freed;
+  ``transfer_ownership`` re-homes them (parity with ``get_raydp_master_owner``,
+  dataset.py:137-158);
+- Arrow payloads round-trip as IPC streams so a reader can decode a table without
+  copying the body buffers (``pa.ipc.open_stream(pa.py_buffer(view))``).
+
+A C++ slab-allocator core can replace the one-segment-per-object layout behind the
+same client API (see ``csrc/``); segment naming and the table protocol are shared.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import threading
+from dataclasses import dataclass, field
+from multiprocessing import resource_tracker, shared_memory
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+import pyarrow as pa
+
+from raydp_tpu.log import get_logger
+
+logger = get_logger("object_store")
+
+KIND_RAW = "raw"
+KIND_PICKLE = "pickle"
+KIND_ARROW = "arrow"
+
+DRIVER_OWNER = "__driver__"
+
+
+def _untrack(shm: shared_memory.SharedMemory) -> None:
+    """Stop Python's resource tracker from unlinking the segment at process exit.
+
+    Lifetime is managed by the store server (and final sweep at session shutdown);
+    3.12 has no ``track=False`` so we unregister manually.
+    """
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+    except Exception:
+        pass
+
+
+def new_object_id() -> str:
+    return secrets.token_hex(16)
+
+
+@dataclass
+class _Entry:
+    segment: str
+    size: int
+    kind: str
+    owner: str
+    refcount: int = 0
+    sealed: bool = True
+
+
+class ObjectStoreServer:
+    """Metadata server for the object table. Runs inside the head process.
+
+    All methods are called through the head's RPC server; they must stay cheap —
+    object payloads never pass through here, only segment names.
+    """
+
+    def __init__(self, session_id: str):
+        self.session_id = session_id
+        self._lock = threading.Lock()
+        self._table: Dict[str, _Entry] = {}
+
+    # -- write path -----------------------------------------------------------
+    def seal(self, object_id: str, segment: str, size: int, kind: str, owner: str) -> None:
+        with self._lock:
+            if object_id in self._table:
+                raise KeyError(f"object {object_id} already sealed")
+            self._table[object_id] = _Entry(segment, size, kind, owner)
+
+    # -- read path ------------------------------------------------------------
+    def lookup(self, object_id: str) -> Tuple[str, int, str]:
+        with self._lock:
+            e = self._table.get(object_id)
+            if e is None:
+                raise KeyError(f"object {object_id} not found")
+            return e.segment, e.size, e.kind
+
+    def contains(self, object_id: str) -> bool:
+        with self._lock:
+            return object_id in self._table
+
+    # -- lifetime -------------------------------------------------------------
+    def add_ref(self, object_ids: List[str]) -> None:
+        with self._lock:
+            for oid in object_ids:
+                e = self._table.get(oid)
+                if e is not None:
+                    e.refcount += 1
+
+    def remove_ref(self, object_ids: List[str]) -> None:
+        freed = []
+        with self._lock:
+            for oid in object_ids:
+                e = self._table.get(oid)
+                if e is not None:
+                    e.refcount -= 1
+                    if e.refcount <= 0 and e.owner is None:
+                        freed.append((oid, e.segment))
+                        del self._table[oid]
+        for _, seg in freed:
+            _unlink_segment(seg)
+
+    def free(self, object_ids: List[str]) -> int:
+        """Explicitly delete objects regardless of owner (release path,
+        parity with ``release_spark_recoverable``, dataset.py:224-237)."""
+        freed = []
+        with self._lock:
+            for oid in object_ids:
+                e = self._table.pop(oid, None)
+                if e is not None:
+                    freed.append(e.segment)
+        for seg in freed:
+            _unlink_segment(seg)
+        return len(freed)
+
+    def transfer_ownership(self, object_ids: List[str], new_owner: str) -> int:
+        with self._lock:
+            n = 0
+            for oid in object_ids:
+                e = self._table.get(oid)
+                if e is not None:
+                    e.owner = new_owner
+                    n += 1
+            return n
+
+    def free_owned_by(self, owner: str) -> int:
+        """Called when an owner (actor) dies or is stopped with cleanup."""
+        freed = []
+        with self._lock:
+            for oid in [o for o, e in self._table.items() if e.owner == owner]:
+                freed.append(self._table.pop(oid).segment)
+        for seg in freed:
+            _unlink_segment(seg)
+        return len(freed)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "num_objects": len(self._table),
+                "total_bytes": sum(e.size for e in self._table.values()),
+                "owners": sorted({e.owner for e in self._table.values()}),
+            }
+
+    def owned_by(self, owner: str) -> List[str]:
+        with self._lock:
+            return [o for o, e in self._table.items() if e.owner == owner]
+
+    def shutdown(self) -> None:
+        with self._lock:
+            segments = [e.segment for e in self._table.values()]
+            self._table.clear()
+        for seg in segments:
+            _unlink_segment(seg)
+
+
+def _unlink_segment(segment: str) -> None:
+    try:
+        shm = shared_memory.SharedMemory(name=segment)
+        _untrack(shm)
+        shm.close()
+        shm.unlink()
+    except FileNotFoundError:
+        pass
+    except Exception as e:  # pragma: no cover
+        logger.warning("failed to unlink segment %s: %s", segment, e)
+
+
+@dataclass
+class ObjectRef:
+    """A handle to a sealed object. Picklable; resolvable in any session process.
+
+    Parity: Ray ``ObjectRef`` + owner address as rehydrated by the reference's
+    ``RayDPUtils.readBinary`` (RayDPUtils.java:45-53). ``get()`` resolves through
+    the process-local :class:`ObjectStoreClient`.
+    """
+
+    id: str
+    size: int = 0
+    kind: str = KIND_PICKLE
+
+    def get(self) -> Any:
+        return get_client().get(self)
+
+    def __hash__(self):
+        return hash(self.id)
+
+
+class ObjectStoreClient:
+    """Per-process client: creates/attaches segments, talks to the table server.
+
+    ``server`` is any object exposing the ObjectStoreServer methods — in the head
+    process it is the server itself; in actor processes it is an RPC proxy.
+    """
+
+    def __init__(self, server, session_id: str, default_owner: str = DRIVER_OWNER):
+        self._server = server
+        self.session_id = session_id
+        self.default_owner = default_owner
+        self._attached: Dict[str, shared_memory.SharedMemory] = {}
+        self._lock = threading.Lock()
+
+    # -- segment naming: session-scoped so shutdown can sweep leftovers -------
+    def _segment_name(self, object_id: str) -> str:
+        return f"rdt{self.session_id[:8]}_{object_id}"
+
+    # -- write ----------------------------------------------------------------
+    def put_raw(self, data, kind: str = KIND_RAW, owner: Optional[str] = None) -> ObjectRef:
+        object_id = new_object_id()
+        size = len(data)
+        seg_name = self._segment_name(object_id)
+        if size == 0:
+            # shm segments cannot be zero-sized; keep 1 byte and record size=0
+            shm = shared_memory.SharedMemory(name=seg_name, create=True, size=1)
+        else:
+            shm = shared_memory.SharedMemory(name=seg_name, create=True, size=size)
+            if isinstance(data, memoryview):
+                shm.buf[:size] = data.cast("B")
+            else:
+                shm.buf[:size] = data
+        _untrack(shm)
+        shm.close()
+        self._server.seal(object_id, seg_name, size, kind, owner or self.default_owner)
+        return ObjectRef(id=object_id, size=size, kind=kind)
+
+    def put(self, obj: Any, owner: Optional[str] = None) -> ObjectRef:
+        if isinstance(obj, pa.Table):
+            return self.put_arrow(obj, owner=owner)
+        return self.put_raw(cloudpickle.dumps(obj), kind=KIND_PICKLE, owner=owner)
+
+    def put_arrow(self, table: pa.Table, owner: Optional[str] = None) -> ObjectRef:
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, table.schema) as writer:
+            writer.write_table(table)
+        buf = sink.getvalue()
+        return self.put_raw(memoryview(buf), kind=KIND_ARROW, owner=owner)
+
+    # -- read -----------------------------------------------------------------
+    def _attach(self, object_id: str) -> Tuple[memoryview, str]:
+        segment, size, kind = self._server.lookup(object_id)
+        with self._lock:
+            shm = self._attached.get(segment)
+            if shm is None:
+                shm = shared_memory.SharedMemory(name=segment)
+                _untrack(shm)
+                self._attached[segment] = shm
+        return shm.buf[:size], kind
+
+    def get_buffer(self, ref: ObjectRef) -> memoryview:
+        view, _ = self._attach(ref.id)
+        return view
+
+    def get(self, ref: ObjectRef) -> Any:
+        view, kind = self._attach(ref.id)
+        if kind == KIND_ARROW:
+            return pa.ipc.open_stream(pa.py_buffer(view)).read_all()
+        if kind == KIND_PICKLE:
+            return cloudpickle.loads(bytes(view))
+        return bytes(view)
+
+    def get_many(self, refs: List[ObjectRef]) -> List[Any]:
+        return [self.get(r) for r in refs]
+
+    # -- lifetime -------------------------------------------------------------
+    def free(self, refs: List[ObjectRef]) -> int:
+        ids = [r.id for r in refs]
+        self._release_attached(ids)
+        return self._server.free(ids)
+
+    def transfer_ownership(self, refs: List[ObjectRef], new_owner: str) -> int:
+        return self._server.transfer_ownership([r.id for r in refs], new_owner)
+
+    def contains(self, ref: ObjectRef) -> bool:
+        return self._server.contains(ref.id)
+
+    def stats(self) -> Dict[str, Any]:
+        return self._server.stats()
+
+    def _release_attached(self, ids: List[str]) -> None:
+        with self._lock:
+            for oid in ids:
+                seg = self._segment_name(oid)
+                shm = self._attached.pop(seg, None)
+                if shm is not None:
+                    try:
+                        shm.close()
+                    except Exception:
+                        pass
+
+    def close(self) -> None:
+        with self._lock:
+            for shm in self._attached.values():
+                try:
+                    shm.close()
+                except Exception:
+                    pass
+            self._attached.clear()
+
+
+# -- process-global client (set by head init / actor bootstrap) ---------------------
+_client: Optional[ObjectStoreClient] = None
+
+
+def set_client(client: Optional[ObjectStoreClient]) -> None:
+    global _client
+    _client = client
+
+
+def get_client() -> ObjectStoreClient:
+    if _client is None:
+        raise RuntimeError(
+            "no object store client in this process; call raydp_tpu.init() first")
+    return _client

@@ -1,0 +1,243 @@
+"""TCP RPC: length-prefixed cloudpickle request/response with multiplexing.
+
+This is the control plane that replaces, in one mechanism, the reference's four
+control channels (SURVEY.md §2.5): py4j driver↔gateway (ray_cluster_master.py:103-183),
+Spark netty RpcEnv (RayAppMaster.scala:63-74), Ray actor RPC, and the MPI gRPC plane
+(mpi/network/network.proto:22-37). One wire format, usable cross-host: frames are
+``8-byte big-endian length || cloudpickle payload``.
+
+Requests are ``(req_id, method, args, kwargs)``; responses ``(req_id, ok, value)``
+where a failed call carries a :class:`RemoteError` payload with the remote traceback.
+Responses may arrive out of order — the client demultiplexes on ``req_id`` — so a
+server may process calls concurrently (actors declare a ``max_concurrency``, parity
+with RayExecutorUtils.java:60 ``setMaxConcurrency(2)``).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import cloudpickle
+
+_LEN = struct.Struct(">Q")
+_MAX_FRAME = 1 << 40
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    """Peer went away mid-call; used by supervisors to detect actor death."""
+
+
+class RemoteError(RpcError):
+    """An exception raised inside the remote handler, with its traceback."""
+
+    def __init__(self, exc_type: str, message: str, remote_traceback: str):
+        super().__init__(f"{exc_type}: {message}\n--- remote traceback ---\n{remote_traceback}")
+        self.exc_type = exc_type
+        self.message = message
+        self.remote_traceback = remote_traceback
+
+    def __reduce__(self):
+        return (RemoteError, (self.exc_type, self.message, self.remote_traceback))
+
+
+def _send_frame(sock: socket.socket, payload: bytes, lock: threading.Lock) -> None:
+    with lock:
+        sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionLost("socket closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if length > _MAX_FRAME:
+        raise RpcError(f"frame too large: {length}")
+    return _recv_exact(sock, length)
+
+
+class RpcServer:
+    """Threaded RPC server dispatching requests to a handler object.
+
+    ``handler(method: str, args, kwargs)`` resolves and runs the call. Dispatch
+    happens on a bounded thread pool of size ``max_concurrency``.
+    """
+
+    def __init__(
+        self,
+        handler: Callable[[str, tuple, dict], Any],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_concurrency: int = 8,
+        name: str = "rpc",
+    ):
+        self._handler = handler
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.address: Tuple[str, int] = self._sock.getsockname()
+        self._pool = ThreadPoolExecutor(max_workers=max_concurrency,
+                                        thread_name_prefix=f"{name}-dispatch")
+        self._stopped = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"{name}-accept", daemon=True)
+        self._conn_threads: list = []
+        self._accept_thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"{self.address[0]}:{self.address[1]}"
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            t.start()
+            self._conn_threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()
+        try:
+            while not self._stopped.is_set():
+                frame = _recv_frame(conn)
+                req_id, method, args, kwargs = cloudpickle.loads(frame)
+                self._pool.submit(self._dispatch, conn, send_lock, req_id, method, args, kwargs)
+        except (ConnectionLost, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, conn, send_lock, req_id, method, args, kwargs) -> None:
+        try:
+            value = self._handler(method, args, kwargs)
+            payload = cloudpickle.dumps((req_id, True, value))
+        except BaseException as e:  # noqa: BLE001 - must serialize any failure
+            err = RemoteError(type(e).__name__, str(e), traceback.format_exc())
+            try:
+                payload = cloudpickle.dumps((req_id, False, err))
+            except Exception:
+                payload = cloudpickle.dumps(
+                    (req_id, False, RemoteError(type(e).__name__, str(e), "<unpicklable>")))
+        try:
+            _send_frame(conn, payload, send_lock)
+        except OSError:
+            pass
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._pool.shutdown(wait=False)
+
+
+class RpcClient:
+    """Persistent connection to one RpcServer; thread-safe; demultiplexes responses."""
+
+    def __init__(self, address: Tuple[str, int], connect_timeout: float = 10.0):
+        self.address = tuple(address)
+        self._sock = socket.create_connection(self.address, timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._pending_lock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._next_id = 0
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = _recv_frame(self._sock)
+                req_id, ok, value = cloudpickle.loads(frame)
+                with self._pending_lock:
+                    fut = self._pending.pop(req_id, None)
+                if fut is None:
+                    continue
+                if ok:
+                    fut.set_result(value)
+                else:
+                    fut.set_exception(value)
+        except (ConnectionLost, OSError, EOFError) as e:
+            self._fail_all(ConnectionLost(f"connection to {self.address} lost: {e}"))
+
+    def _fail_all(self, exc: Exception) -> None:
+        self._closed = True
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+
+    def submit(self, method: str, *args, **kwargs) -> Future:
+        if self._closed:
+            raise ConnectionLost(f"connection to {self.address} closed")
+        fut: Future = Future()
+        with self._pending_lock:
+            req_id = self._next_id
+            self._next_id += 1
+            self._pending[req_id] = fut
+        payload = cloudpickle.dumps((req_id, method, args, kwargs))
+        try:
+            _send_frame(self._sock, payload, self._send_lock)
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            self._fail_all(ConnectionLost(str(e)))
+            raise ConnectionLost(f"send to {self.address} failed: {e}") from e
+        return fut
+
+    def call(self, method: str, *args, timeout: Optional[float] = None, **kwargs) -> Any:
+        return self.submit(method, *args, **kwargs).result(timeout=timeout)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class MethodDispatcher:
+    """Maps RPC method names to bound methods of a target object.
+
+    Methods starting with ``_`` are not callable remotely.
+    """
+
+    def __init__(self, target: Any):
+        self._target = target
+
+    def __call__(self, method: str, args: tuple, kwargs: dict) -> Any:
+        if method.startswith("_"):
+            raise AttributeError(f"method {method!r} is not remotely callable")
+        fn = getattr(self._target, method, None)
+        if fn is None or not callable(fn):
+            raise AttributeError(
+                f"{type(self._target).__name__} has no remote method {method!r}")
+        return fn(*args, **kwargs)

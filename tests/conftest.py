@@ -1,0 +1,54 @@
+"""Test harness.
+
+Parity with the reference's strategy (SURVEY.md §4): real local runtime, simulated
+multi-host topology, kill-based fault injection. The JAX analogue of
+``ray.cluster_utils.Cluster`` is a virtual 8-device CPU mesh: we force the host
+platform before anything imports jax (must happen at conftest import time).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def runtime():
+    """A bare actor runtime (no ETL session), torn down after the test."""
+    from raydp_tpu.runtime import init_runtime, shutdown_runtime
+
+    rt = init_runtime()
+    yield rt
+    shutdown_runtime()
+
+
+@pytest.fixture
+def runtime_3nodes():
+    """Three virtual nodes for placement/fault tests
+    (parity: test_spark_cluster.py:90-110 heterogeneous virtual nodes)."""
+    from raydp_tpu.runtime import init_runtime, shutdown_runtime
+
+    rt = init_runtime(virtual_nodes=[
+        {"CPU": 4.0, "memory": float(2 << 30)},
+        {"CPU": 4.0, "memory": float(2 << 30)},
+        {"CPU": 4.0, "memory": float(2 << 30), "accel": 1.0},
+    ])
+    yield rt
+    shutdown_runtime()
+
+
+@pytest.fixture
+def session():
+    """A 2-executor ETL session (parity: conftest.py spark_on_ray_2_executors)."""
+    import raydp_tpu
+
+    s = raydp_tpu.init("pytest", num_executors=2, executor_cores=1,
+                       executor_memory="512MB")
+    yield s
+    raydp_tpu.stop()
