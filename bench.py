@@ -1,0 +1,86 @@
+"""End-to-end benchmark: NYCTaxi CSV → distributed feature ETL → TPU MLP training.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: training samples/sec/chip for the Spark-ETL→train pipeline (BASELINE.md).
+The reference publishes no numbers (BASELINE.md: self-measured); ``REF_BASELINE``
+holds our recorded reference-equivalent throughput once measured — until then
+``vs_baseline`` is reported against the first recorded run of this bench.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+# Reference-equivalent baseline (samples/sec/chip) for this exact workload.
+# The reference repo publishes none (BASELINE.md); this constant records the
+# first stable measurement of this pipeline (round 1, v5e-1, bf16, batch 8192:
+# 498k samples/s/chip) so later rounds track speedups against it.
+REF_BASELINE = 498_000.0
+
+ROWS = int(os.environ.get("BENCH_ROWS", "400000"))
+EPOCHS = int(os.environ.get("BENCH_EPOCHS", "4"))
+BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
+
+
+def main():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "examples"))
+    import optax
+
+    import raydp_tpu
+    from generate_nyctaxi import generate
+    from nyctaxi_features import LABEL, feature_columns, nyc_taxi_preprocess
+    from raydp_tpu.models import NYCTaxiModel
+    from raydp_tpu.train import FlaxEstimator
+
+    import jax
+    num_chips = max(1, len(jax.devices()))
+
+    tmp = tempfile.mkdtemp(prefix="rdt-bench-")
+    csv_path = os.path.join(tmp, "nyctaxi.csv")
+    generate(ROWS).to_csv(csv_path, index=False)
+
+    session = raydp_tpu.init("bench", num_executors=2, executor_cores=2,
+                             executor_memory="2GB")
+    try:
+        data = session.read.csv(csv_path, num_partitions=4)
+        data = nyc_taxi_preprocess(data)
+        features = feature_columns(data)
+
+        import jax.numpy as jnp
+        est = FlaxEstimator(
+            model=NYCTaxiModel(dtype=jnp.bfloat16),
+            optimizer=optax.adam(1e-3),
+            loss="smooth_l1",
+            feature_columns=features,
+            label_column=LABEL,
+            batch_size=BATCH,
+            num_epochs=EPOCHS,
+            shuffle=True,
+        )
+        t0 = time.perf_counter()
+        result = est.fit_on_frame(data)
+        total_s = time.perf_counter() - t0
+
+        # steady-state throughput: skip epoch 0 (compile)
+        steady = result.history[1:] or result.history
+        sps = sum(r["samples_per_s"] for r in steady) / len(steady)
+        sps_per_chip = sps / num_chips
+        print(json.dumps({
+            "metric": "nyctaxi_e2e_train_samples_per_sec_per_chip",
+            "value": round(sps_per_chip, 1),
+            "unit": "samples/s/chip",
+            "vs_baseline": round(sps_per_chip / REF_BASELINE, 3),
+        }))
+        print(f"# rows={ROWS} epochs={EPOCHS} batch={BATCH} chips={num_chips} "
+              f"total_wall_s={total_s:.1f}", file=sys.stderr)
+    finally:
+        raydp_tpu.stop()
+
+
+if __name__ == "__main__":
+    main()

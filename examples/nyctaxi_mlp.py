@@ -1,0 +1,69 @@
+"""End-to-end NYCTaxi fare regression — the port of the reference's headline
+example (examples/pytorch_nyctaxi.py): CSV → distributed feature ETL on CPU
+actors → recoverable Arrow handoff → pjit-compiled MLP training on TPU.
+
+Run: python examples/nyctaxi_mlp.py [--rows 100000] [--epochs 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import optax
+
+import raydp_tpu
+from nyctaxi_features import LABEL, feature_columns, nyc_taxi_preprocess
+from raydp_tpu.models import NYCTaxiModel
+from raydp_tpu.train import FlaxEstimator
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=100_000)
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=1024)
+    ap.add_argument("--num-executors", type=int, default=2)
+    ap.add_argument("--csv", default=None)
+    args = ap.parse_args()
+
+    csv_path = args.csv
+    if csv_path is None:
+        from generate_nyctaxi import generate
+        csv_path = os.path.join(tempfile.mkdtemp(), "nyctaxi.csv")
+        generate(args.rows).to_csv(csv_path, index=False)
+
+    session = raydp_tpu.init(
+        "nyctaxi", num_executors=args.num_executors, executor_cores=1,
+        executor_memory="1GB")
+    try:
+        data = session.read.csv(csv_path, num_partitions=args.num_executors * 2)
+        data = nyc_taxi_preprocess(data)
+        train_df, test_df = data.randomSplit([0.9, 0.1], seed=0)
+        features = feature_columns(data)
+        print(f"{len(features)} features: {features}")
+
+        estimator = FlaxEstimator(
+            model=NYCTaxiModel(),
+            optimizer=optax.adam(1e-3),
+            loss="smooth_l1",
+            feature_columns=features,
+            label_column=LABEL,
+            batch_size=args.batch_size,
+            num_epochs=args.epochs,
+            metrics=["mae", "mse"],
+        )
+        result = estimator.fit_on_frame(train_df, test_df)
+        for row in result.history:
+            print(row)
+    finally:
+        raydp_tpu.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -90,7 +90,8 @@ class _Context:
 
         if self.session is not None:
             self.session.stop(cleanup_data=cleanup_data)
-            self.session = None
+            if cleanup_data:
+                self.session = None
         if runtime_initialized():
             if self._placement_group is not None:
                 get_runtime().resource_manager.remove_group(

@@ -1,0 +1,29 @@
+"""raydp_tpu.data — the ETL↔training data plane.
+
+Parity: the reference's L4 conversion layer (SURVEY.md §1) — Spark DataFrame ↔ Ray
+Dataset through Arrow IPC in the object store (spark/dataset.py), including the
+recoverable path (``from_spark_recoverable``/``release``, dataset.py:172-237), the
+reverse ``to_spark`` path with master-held objects (dataset.py:239-313), and the
+balanced per-rank sharding kernel (utils.py:149-222). The TPU-specific tail is
+:mod:`feed`: Arrow blocks → pinned host numpy → ``jax.device_put`` with a
+``NamedSharding`` so batches land already sharded over the mesh's data axis.
+"""
+
+from raydp_tpu.data.dataset import (
+    DistributedDataset,
+    from_frame,
+    from_frame_recoverable,
+    release,
+    to_frame,
+)
+from raydp_tpu.data.feed import DeviceFeed, ShardSpec
+
+__all__ = [
+    "DistributedDataset",
+    "from_frame",
+    "from_frame_recoverable",
+    "release",
+    "to_frame",
+    "DeviceFeed",
+    "ShardSpec",
+]

@@ -1,0 +1,195 @@
+"""DeviceFeed: Arrow blocks → device-sharded ``jax.Array`` batches.
+
+This is the TPU-specific tail of the data plane, replacing the reference's
+``dataset.to_torch`` + DataLoader feed (torch/estimator.py:226-241) and its
+background-prefetch trick (``PrefetchedDataLoader``, torch_ml_dataset.py:69-108).
+Design for the hardware: batches are assembled host-side as contiguous numpy
+(decode is zero-copy out of shared memory wherever Arrow allows), then placed with
+``jax.device_put`` under a ``NamedSharding`` over the mesh's data axis, so the
+train step's inputs are already distributed and XLA inserts no gather. Shapes are
+static (``drop_remainder``) — a changing batch dimension would retrace/recompile
+under jit. A background thread keeps ``prefetch`` host batches ahead so input
+assembly overlaps device compute.
+
+Multi-host: each process feeds its own shard and the global array is built with
+``jax.make_array_from_process_local_data`` — the per-host ``device_put`` endpoint
+of SURVEY.md §2.5's "TPU-native equivalent".
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import pyarrow as pa
+
+from raydp_tpu.log import get_logger
+
+logger = get_logger("data.feed")
+
+
+@dataclass
+class ShardSpec:
+    """What one data-parallel rank reads: ``(block_index, offset, length)``."""
+
+    parts: List[Tuple[int, int, int]] = field(default_factory=list)
+
+    def num_rows(self) -> int:
+        return sum(n for _, _, n in self.parts)
+
+
+ColumnSpec = Union[str, Sequence[str]]
+
+
+def _as_numpy(table: pa.Table, columns: Sequence[str], dtype) -> np.ndarray:
+    """Stack columns into [rows, len(columns)] (or [rows] for one column)."""
+    arrays = []
+    for c in columns:
+        col = table.column(c)
+        arrays.append(col.to_numpy(zero_copy_only=False).astype(dtype, copy=False))
+    if len(arrays) == 1:
+        return arrays[0]
+    return np.stack(arrays, axis=1)
+
+
+class HostBatchIterator:
+    """Yields host-side numpy batch dicts from a dataset (or one shard of it)."""
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        columns: Dict[str, Tuple[ColumnSpec, np.dtype]],
+        shard: Optional[ShardSpec] = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_remainder: bool = True,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.columns = {
+            name: ((cols,) if isinstance(cols, str) else tuple(cols), np.dtype(dt))
+            for name, (cols, dt) in columns.items()
+        }
+        self.shard = shard
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+
+    def _parts(self) -> List[Tuple[int, int, int]]:
+        if self.shard is not None:
+            return list(self.shard.parts)
+        return [(i, 0, self.dataset._blocks[i].num_rows)
+                for i in range(self.dataset.num_blocks())]
+
+    def __iter__(self):
+        rng = np.random.RandomState(self.seed)
+        parts = self._parts()
+        if self.shuffle:
+            rng.shuffle(parts)
+        buffers: Dict[str, List[np.ndarray]] = {n: [] for n in self.columns}
+        buffered = 0
+        for block_idx, off, length in parts:
+            table = self.dataset.get_block(block_idx).slice(off, length)
+            if self.shuffle and table.num_rows > 1:
+                perm = rng.permutation(table.num_rows)
+                table = table.take(pa.array(perm))
+            for name, (cols, dt) in self.columns.items():
+                buffers[name].append(_as_numpy(table, cols, dt))
+            buffered += table.num_rows
+            while buffered >= self.batch_size:
+                batch, buffers, buffered = self._cut_batch(buffers, buffered)
+                yield batch
+        if buffered > 0 and not self.drop_remainder:
+            batch = {n: np.concatenate(v, axis=0) for n, v in buffers.items()}
+            yield batch
+
+    def _cut_batch(self, buffers, buffered):
+        joined = {n: (np.concatenate(v, axis=0) if len(v) > 1 else v[0])
+                  for n, v in buffers.items()}
+        batch = {n: a[: self.batch_size] for n, a in joined.items()}
+        rest = {n: [a[self.batch_size:]] for n, a in joined.items()}
+        return batch, rest, buffered - self.batch_size
+
+
+class DeviceFeed:
+    """Prefetching iterator of device-sharded batches over a mesh data axis."""
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        columns: Dict[str, Tuple[ColumnSpec, np.dtype]],
+        mesh=None,
+        data_axis: str = "data",
+        shard: Optional[ShardSpec] = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        prefetch: int = 2,
+        drop_remainder: bool = True,
+    ):
+        import jax
+        self._jax = jax
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.host_iter = HostBatchIterator(
+            dataset, batch_size, columns, shard=shard, shuffle=shuffle,
+            seed=seed, drop_remainder=drop_remainder)
+        self.prefetch = max(1, prefetch)
+        self._shardings = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            self._sharding = NamedSharding(mesh, PartitionSpec(data_axis))
+        else:
+            self._sharding = None
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed per-epoch so shuffling differs across epochs deterministically."""
+        if not hasattr(self, "_base_seed"):
+            self._base_seed = self.host_iter.seed
+        self.host_iter.seed = (self._base_seed + (epoch + 1) * 1000003) % (2**31 - 1)
+
+    def _place(self, batch: Dict[str, np.ndarray]):
+        jax = self._jax
+        if self._sharding is None:
+            return {n: jax.device_put(a) for n, a in batch.items()}
+        if jax.process_count() > 1:
+            return {
+                n: jax.make_array_from_process_local_data(self._sharding, a)
+                for n, a in batch.items()
+            }
+        return {n: jax.device_put(a, self._sharding) for n, a in batch.items()}
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        SENTINEL = object()
+
+        def _producer():
+            try:
+                for batch in self.host_iter:
+                    if stop.is_set():
+                        return
+                    q.put(batch)
+            except BaseException as e:  # propagate into consumer
+                q.put(e)
+                return
+            finally:
+                q.put(SENTINEL)
+
+        t = threading.Thread(target=_producer, daemon=True,
+                             name="devicefeed-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is SENTINEL:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield self._place(item)
+        finally:
+            stop.set()

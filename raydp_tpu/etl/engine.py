@@ -1,0 +1,464 @@
+"""The driver-side execution engine: plan → stages → tasks on executor actors.
+
+This plays the role Spark's driver plays for the reference: it splits the plan at
+wide operators, schedules partition tasks onto executor actors with locality (a
+cached block's task prefers the executor holding it, like ``getBlockLocations``
+routing in ObjectStoreWriter.scala:196-202), bounds in-flight work per executor,
+and retries failed tasks — possible on any executor because tasks are lineage
+recipes (SURVEY.md §5 failure-detection subsystem).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import uuid
+from concurrent.futures import FIRST_COMPLETED, wait
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+import numpy as np
+import pyarrow as pa
+
+from raydp_tpu.etl import plan as P
+from raydp_tpu.etl import tasks as T
+from raydp_tpu.etl.expressions import col as _col
+from raydp_tpu.log import get_logger
+from raydp_tpu.runtime.actor import ActorHandle
+from raydp_tpu.runtime.object_store import ObjectRef, get_client
+from raydp_tpu.runtime.rpc import ConnectionLost, RemoteError
+
+logger = get_logger("etl.engine")
+
+
+class StageError(RuntimeError):
+    pass
+
+
+def _root_limit(node: P.PlanNode) -> Optional[int]:
+    """The global row cap when the plan's root is a ``Limit`` (possibly under
+    other per-row-preserving narrow ops). The compiled LimitStep truncates each
+    partition; the action applies the exact global cut."""
+    while isinstance(node, (P.Rename,)):
+        node = node.child
+    return node.n if isinstance(node, P.Limit) else None
+
+
+# deterministic application failures: retrying replays the same exception, so
+# fail fast with the original error instead of burning the retry budget
+_NO_RETRY_EXC_TYPES = {
+    "KeyError", "ValueError", "TypeError", "AttributeError", "IndexError",
+    "ZeroDivisionError", "ArrowInvalid", "ArrowNotImplementedError",
+    "ArrowKeyError", "ArrowTypeError",
+}
+
+
+class ExecutorPool:
+    """Round-robin scheduler over executor actor handles with retry.
+
+    Retry parity: the reference's fetch tasks run with ``max_retries=-1``
+    (dataset.py:54) and executor actors revive with ``maxRestarts=-1``; we retry a
+    bounded-but-generous number of times, re-resolving the actor between attempts
+    (a restarted actor keeps its name at a new address).
+    """
+
+    def __init__(self, executors: List[ActorHandle], max_task_retries: int = 8):
+        if not executors:
+            raise ValueError("executor pool is empty")
+        self.executors = list(executors)
+        self.by_name = {h.name: h for h in executors}
+        self.max_task_retries = max_task_retries
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def _next_executor(self) -> ActorHandle:
+        with self._lock:
+            h = self.executors[self._rr % len(self.executors)]
+            self._rr += 1
+            return h
+
+    def run_tasks(
+        self,
+        tasks: Sequence[T.Task],
+        preferred: Optional[Sequence[Optional[str]]] = None,
+        max_inflight_per_executor: int = 4,
+    ) -> List[Dict[str, Any]]:
+        """Run tasks, preserving order of results; blocks until all complete."""
+        n = len(tasks)
+        results: List[Optional[Dict[str, Any]]] = [None] * n
+        attempts = [0] * n
+        max_inflight = max(1, max_inflight_per_executor * len(self.executors))
+        pending: Dict[Any, Tuple[int, str]] = {}
+        next_idx = 0
+
+        def _submit(i: int):
+            name = None
+            if preferred is not None and preferred[i] is not None \
+                    and attempts[i] == 0:
+                name = preferred[i]
+            handle = self.by_name.get(name) if name else None
+            if handle is None:
+                handle = self._next_executor()
+            payload = cloudpickle.dumps(tasks[i])
+            try:
+                fut = handle.submit("run_task", payload)
+            except (ConnectionLost, OSError) as e:
+                raise StageError(f"cannot reach executor {handle.name}: {e}") from e
+            pending[fut] = (i, handle.name or "")
+
+        while next_idx < n and len(pending) < max_inflight:
+            _submit(next_idx)
+            next_idx += 1
+
+        while pending:
+            done, _ = wait(list(pending.keys()), return_when=FIRST_COMPLETED)
+            for fut in done:
+                i, ename = pending.pop(fut)
+                err = fut.exception()
+                if err is None:
+                    results[i] = fut.result()
+                else:
+                    attempts[i] += 1
+                    if (isinstance(err, RemoteError)
+                            and err.exc_type in _NO_RETRY_EXC_TYPES):
+                        raise StageError(
+                            f"task {tasks[i].task_id} failed: {err}") from err
+                    if attempts[i] > self.max_task_retries:
+                        raise StageError(
+                            f"task {tasks[i].task_id} failed after "
+                            f"{attempts[i]} attempts: {err}") from err
+                    logger.warning("task %s failed on %s (attempt %d): %s",
+                                   tasks[i].task_id, ename, attempts[i],
+                                   str(err).splitlines()[0] if str(err) else err)
+                    _submit(i)
+            while next_idx < n and len(pending) < max_inflight:
+                _submit(next_idx)
+                next_idx += 1
+        return results  # type: ignore[return-value]
+
+
+class Engine:
+    def __init__(self, pool: ExecutorPool, shuffle_partitions: int = 8,
+                 owner: Optional[str] = None):
+        self.pool = pool
+        self.shuffle_partitions = shuffle_partitions
+        self.owner = owner
+        # shuffle intermediates created while compiling the current action;
+        # freed when the action finishes (or pinned for cached frames)
+        self._temp_refs: List[ObjectRef] = []
+
+    def _drain_temps(self) -> List[ObjectRef]:
+        temps, self._temp_refs = self._temp_refs, []
+        return temps
+
+    def _free_temps(self) -> None:
+        temps = self._drain_temps()
+        if temps:
+            try:
+                get_client().free(temps)
+            except Exception:
+                logger.warning("failed to free %d shuffle intermediates", len(temps))
+
+    # ---- public entry points ------------------------------------------------
+    def materialize(self, node: P.PlanNode, owner: Optional[str] = None
+                    ) -> Tuple[List[ObjectRef], Optional[bytes], List[int]]:
+        """Execute the plan; return per-partition (refs, schema bytes, row counts)."""
+        try:
+            return self._materialize_inner(node, owner)
+        finally:
+            self._free_temps()
+
+    def _materialize_inner(self, node: P.PlanNode, owner: Optional[str] = None):
+        tasks, preferred = self._compile(node)
+        tasks = [t.with_output(output=T.RETURN_REF, owner=owner or self.owner)
+                 for t in tasks]
+        results = self.pool.run_tasks(tasks, preferred)
+        refs = [r["ref"] for r in results]
+        schema = results[0]["schema"] if results else None
+        num_rows = [r["num_rows"] for r in results]
+        return refs, schema, num_rows
+
+    def collect(self, node: P.PlanNode) -> pa.Table:
+        try:
+            tasks, preferred = self._compile(node)
+            tasks = [t.with_output(output=T.COLLECT) for t in tasks]
+            results = self.pool.run_tasks(tasks, preferred)
+            tables = [pa.ipc.open_stream(pa.py_buffer(r["ipc"])).read_all()
+                      for r in results]
+            out = pa.concat_tables(tables, promote_options="permissive")
+            limit = _root_limit(node)
+            return out.slice(0, limit) if limit is not None else out
+        finally:
+            self._free_temps()
+
+    def count(self, node: P.PlanNode) -> int:
+        try:
+            tasks, preferred = self._compile(node)
+            tasks = [t.with_output(output=T.ROWCOUNT) for t in tasks]
+            results = self.pool.run_tasks(tasks, preferred)
+            total = sum(r["num_rows"] for r in results)
+            limit = _root_limit(node)
+            return min(total, limit) if limit is not None else total
+        finally:
+            self._free_temps()
+
+    def cache(self, node: P.PlanNode, frame_id: str) -> P.CachedScan:
+        """Materialize into executor block caches with lineage recipes.
+
+        Parity: ``prepareRecoverableRDD`` = persist + count + pin + locations map
+        (ObjectStoreWriter.scala:164-204). The returned ``CachedScan`` carries,
+        per partition: the cache key, the executor that holds it, and the pickled
+        recipe that can rebuild it anywhere. Shuffle intermediates feeding the
+        cached plan are pinned (not freed) because the lineage recipes reference
+        them — they are released with the frame (the GC-pin of
+        ObjectStoreWriter.scala:175-177).
+        """
+        try:
+            tasks, preferred = self._compile(node)
+            cache_tasks, recover_blobs, keys = [], [], []
+            for i, t in enumerate(tasks):
+                key = f"block_{frame_id}_{i}"
+                recover = t.with_output(output=T.RETURN_REF)
+                recover_blobs.append(cloudpickle.dumps(recover))
+                keys.append(key)
+                cache_tasks.append(t.with_output(output=T.CACHE, cache_key=key))
+            results = self.pool.run_tasks(cache_tasks, preferred)
+            executors = [r["executor"] for r in results]
+            schema = results[0]["schema"] if results else None
+            return P.CachedScan(frame_id=frame_id, cache_keys=keys,
+                                executors=executors, recover_tasks=recover_blobs,
+                                schema=schema, pinned_refs=self._drain_temps())
+        finally:
+            self._free_temps()
+
+    def num_partitions(self, node: P.PlanNode) -> int:
+        try:
+            tasks, _ = self._compile(node)
+            return len(tasks)
+        finally:
+            self._free_temps()
+
+    # ---- compilation --------------------------------------------------------
+    def _compile(self, node: P.PlanNode
+                 ) -> Tuple[List[T.Task], List[Optional[str]]]:
+        """Return (tasks, preferred-executor-per-task)."""
+        if isinstance(node, P.RangeScan):
+            per = math.ceil((node.stop - node.start) / max(node.step, 1)
+                            / node.num_partitions)
+            tasks = []
+            for i in range(node.num_partitions):
+                lo = node.start + i * per * node.step
+                hi = min(node.start + (i + 1) * per * node.step, node.stop)
+                tasks.append(self._task(T.RangeSource(lo, hi, node.step, node.column)))
+            return tasks, [None] * len(tasks)
+
+        if isinstance(node, P.CsvScan):
+            return self._compile_csv(node)
+
+        if isinstance(node, P.ParquetScan):
+            return self._compile_parquet(node)
+
+        if isinstance(node, P.InMemory):
+            tasks = [self._task(T.ArrowRefSource([ref], schema=node.schema))
+                     for ref in node.refs]
+            return tasks, [None] * len(tasks)
+
+        if isinstance(node, P.CachedScan):
+            tasks, preferred = [], []
+            for key, executor, recover in zip(
+                    node.cache_keys, node.executors, node.recover_tasks):
+                rec_task: T.Task = cloudpickle.loads(recover)
+                tasks.append(self._task(T.CachedSource(key, rec_task)))
+                preferred.append(executor)
+            return tasks, preferred
+
+        # ---- narrow unary: fuse into child's task chains ----
+        narrow = {
+            P.Project: lambda n: T.ProjectStep(n.columns),
+            P.Filter: lambda n: T.FilterStep(n.predicate),
+            P.DropNa: lambda n: T.DropNaStep(n.subset),
+            P.Limit: lambda n: T.LimitStep(n.n),
+            P.Rename: lambda n: T.RenameStep(n.mapping),
+        }
+        for cls, make in narrow.items():
+            if isinstance(node, cls):
+                tasks, preferred = self._compile(node.child)
+                step = make(node)
+                return [t.with_output(steps=t.steps + [step]) for t in tasks], preferred
+
+        if isinstance(node, P.Sample):
+            tasks, preferred = self._compile(node.child)
+            out = [t.with_output(steps=t.steps + [
+                T.SampleStep(node.fraction, node.seed, i)])
+                for i, t in enumerate(tasks)]
+            return out, preferred
+
+        if isinstance(node, P.SplitSelect):
+            tasks, preferred = self._compile(node.child)
+            out = [t.with_output(steps=t.steps + [
+                T.SplitSelectStep(node.lo, node.hi, node.seed, i)])
+                for i, t in enumerate(tasks)]
+            return out, preferred
+
+        # ---- wide: execute child, shuffle through the object store ----
+        if isinstance(node, P.Repartition):
+            return self._compile_repartition(node)
+
+        if isinstance(node, P.GroupAgg):
+            return self._compile_groupagg(node)
+
+        if isinstance(node, P.Join):
+            return self._compile_join(node)
+
+        if isinstance(node, P.Sort):
+            return self._compile_sort(node)
+
+        if isinstance(node, P.Union):
+            all_tasks, all_pref = [], []
+            for child in node.inputs:
+                tasks, preferred = self._compile(child)
+                all_tasks.extend(tasks)
+                all_pref.extend(preferred)
+            return all_tasks, all_pref
+
+        raise TypeError(f"unknown plan node {type(node).__name__}")
+
+    # ---- leaves -------------------------------------------------------------
+    def _task(self, source: T.Step, steps: Optional[List[T.Step]] = None) -> T.Task:
+        return T.Task(task_id=f"t-{uuid.uuid4().hex[:10]}", source=source,
+                      steps=steps or [])
+
+    def _compile_csv(self, node: P.CsvScan):
+        tasks = []
+        for path in node.paths:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                header = f.readline()
+            body = size - len(header)
+            nparts = node.num_partitions or max(
+                1, min(self.shuffle_partitions, body // (8 << 20) + 1))
+            per = math.ceil(body / nparts) if body > 0 else 1
+            for i in range(nparts):
+                start = len(header) + i * per
+                end = min(len(header) + (i + 1) * per, size)
+                if start >= size:
+                    break
+                tasks.append(self._task(T.CsvSliceSource(
+                    path, start if i > 0 else 0, end, header, node.options)))
+        return tasks, [None] * len(tasks)
+
+    def _compile_parquet(self, node: P.ParquetScan):
+        import pyarrow.parquet as pq
+        tasks = []
+        for path in node.paths:
+            f = pq.ParquetFile(path)
+            for rg in range(f.num_row_groups):
+                tasks.append(self._task(T.ParquetSource(path, [rg], node.columns)))
+            if f.num_row_groups == 0:
+                tasks.append(self._task(T.ParquetSource(path, None, node.columns)))
+        return tasks, [None] * len(tasks)
+
+    # ---- wide operators -----------------------------------------------------
+    def _shuffle_children(self, node: P.PlanNode, num_buckets: int,
+                          keys: Optional[List[str]],
+                          range_key=None) -> Tuple[List[List[ObjectRef]], Optional[bytes]]:
+        """Execute ``node`` with SHUFFLE output; transpose map×bucket → bucket×map."""
+        tasks, preferred = self._compile(node)
+        tasks = [t.with_output(output=T.SHUFFLE, num_buckets=num_buckets,
+                               shuffle_keys=keys, range_key=range_key,
+                               owner=self.owner)
+                 for t in tasks]
+        results = self.pool.run_tasks(tasks, preferred)
+        schema = results[0]["schema"] if results else None
+        buckets: List[List[ObjectRef]] = [[] for _ in range(num_buckets)]
+        for r in results:
+            for b, ref in enumerate(r["bucket_refs"]):
+                buckets[b].append(ref)
+                self._temp_refs.append(ref)
+        return buckets, schema
+
+    def _compile_repartition(self, node: P.Repartition):
+        n = node.num_partitions
+        if not node.shuffle:
+            # coalesce: group existing partitions without moving rows by key
+            refs, schema, _ = self._materialize_inner(node.child)
+            self._temp_refs.extend(refs)
+            groups = np.array_split(np.arange(len(refs)), n)
+            tasks = [self._task(T.ArrowRefSource([refs[i] for i in g], schema=schema))
+                     for g in groups if len(g) > 0]
+            return tasks, [None] * len(tasks)
+        buckets, schema = self._shuffle_children(node.child, n, keys=None)
+        tasks = [self._task(T.ArrowRefSource(bucket, schema=schema))
+                 for bucket in buckets]
+        return tasks, [None] * len(tasks)
+
+    def _compile_groupagg(self, node: P.GroupAgg):
+        nb = min(self.shuffle_partitions, max(1, len(self.pool.executors) * 2))
+        buckets, schema = self._shuffle_children(node.child, nb, keys=node.keys)
+        tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
+                            [T.GroupAggStep(node.keys, node.aggs)])
+                 for bucket in buckets]
+        return tasks, [None] * len(tasks)
+
+    def _compile_join(self, node: P.Join):
+        nb = min(self.shuffle_partitions, max(1, len(self.pool.executors) * 2))
+        left_buckets, lschema = self._shuffle_children(node.left, nb, node.keys)
+        right_buckets, rschema = self._shuffle_children(node.right, nb,
+                                                        node.right_keys)
+        tasks = []
+        for lb, rb in zip(left_buckets, right_buckets):
+            tasks.append(self._task(
+                T.ArrowRefSource(lb, schema=lschema),
+                [T.HashJoinStep(rb, node.keys, node.right_keys, node.how,
+                                right_schema=rschema)]))
+        return tasks, [None] * len(tasks)
+
+    def _compile_sort(self, node: P.Sort):
+        """Range-partitioned sort: materialize the child ONCE, sample boundary
+        values from a few blocks (any orderable type — no numeric cast), range-
+        shuffle those refs, locally sort each range."""
+        key, order = node.keys[0]
+        refs, schema, num_rows = self._materialize_inner(node.child)
+        self._temp_refs.extend(refs)
+        client = get_client()
+
+        # boundary sample: up to 4 non-empty blocks read driver-side
+        sampled = []
+        for ref, n in zip(refs, num_rows):
+            if n > 0:
+                sampled.append(client.get(ref).column(key))
+            if len(sampled) >= 4:
+                break
+        nb = min(self.shuffle_partitions, max(1, len(self.pool.executors) * 2))
+        if not sampled:
+            boundaries: List = []
+        else:
+            values = pa.concat_arrays(
+                [c.combine_chunks() for c in sampled]).sort()
+            qpos = [int(q * (len(values) - 1))
+                    for q in np.linspace(0, 1, nb + 1)[1:-1]]
+            boundaries = []
+            for p in qpos:
+                v = values[p].as_py()
+                if not boundaries or v != boundaries[-1]:
+                    boundaries.append(v)
+
+        shuffle_tasks = [
+            self._task(T.ArrowRefSource([ref], schema=schema)).with_output(
+                output=T.SHUFFLE, num_buckets=len(boundaries) + 1,
+                range_key=(key, boundaries), owner=self.owner)
+            for ref in refs
+        ]
+        results = self.pool.run_tasks(shuffle_tasks)
+        buckets: List[List[ObjectRef]] = [[] for _ in range(len(boundaries) + 1)]
+        for r in results:
+            for b, ref in enumerate(r["bucket_refs"]):
+                buckets[b].append(ref)
+                self._temp_refs.append(ref)
+        if order == "descending":
+            buckets = buckets[::-1]
+        tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
+                            [T.LocalSortStep(node.keys)])
+                 for bucket in buckets]
+        return tasks, [None] * len(tasks)

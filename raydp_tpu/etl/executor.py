@@ -1,0 +1,201 @@
+"""The ETL executor actor: computes partitions, serves cached blocks.
+
+Parity: ``RayDPExecutor`` — a worker hosted as a runtime actor that computes
+partitions and doubles as the data-plane server for cached Arrow blocks
+(RayDPExecutor.scala:103-249 serves Spark tasks; 271-355 serves
+``getBlockLocations``/``getRDDPartition`` with recache-on-miss). Restart behavior:
+a revived executor re-registers with the master under a fresh executor id and the
+master keeps the old→new mapping (RayDPExecutor.scala:82-101,
+RayAppMaster.scala:192-209); our executor does the same through
+``current_actor_context().was_restarted``.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+import pyarrow as pa
+
+from raydp_tpu.etl import tasks as T
+from raydp_tpu.log import get_logger
+from raydp_tpu.runtime.actor import current_actor_context
+from raydp_tpu.runtime.object_store import get_client
+
+logger = get_logger("etl.executor")
+
+
+class BlockCache:
+    """In-memory named Arrow block cache (the BlockManager analogue)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._blocks: Dict[str, pa.Table] = {}
+
+    def get(self, key: str) -> Optional[pa.Table]:
+        with self._lock:
+            return self._blocks.get(key)
+
+    def put(self, key: str, table: pa.Table) -> None:
+        with self._lock:
+            self._blocks[key] = table
+
+    def drop(self, keys: List[str]) -> int:
+        with self._lock:
+            n = 0
+            for k in keys:
+                if self._blocks.pop(k, None) is not None:
+                    n += 1
+            return n
+
+    def drop_prefix(self, prefix: str) -> int:
+        with self._lock:
+            victims = [k for k in self._blocks if k.startswith(prefix)]
+            for k in victims:
+                del self._blocks[k]
+            return len(victims)
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._blocks)
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(t.nbytes for t in self._blocks.values())
+
+
+_block_cache: Optional[BlockCache] = None
+
+
+def current_block_cache() -> BlockCache:
+    """The block cache of the executor actor this code is running in."""
+    if _block_cache is None:
+        raise RuntimeError("no block cache: not inside an ETL executor actor")
+    return _block_cache
+
+
+class EtlExecutor:
+    """Actor class. One instance per executor process."""
+
+    def __init__(self, master_name: Optional[str] = None):
+        global _block_cache
+        self.cache = BlockCache()
+        _block_cache = self.cache
+        self.executor_id: Optional[str] = None
+        ctx = current_actor_context()
+        self._actor_name = ctx.name if ctx else f"local-{uuid.uuid4().hex[:6]}"
+        # register with the master; a restarted actor asks for a fresh executor id
+        # (parity: RequestAddPendingRestartedExecutor, RayAppMaster.scala:192-209)
+        if master_name and ctx is not None:
+            from raydp_tpu.runtime.head import ENV_HEAD  # noqa: F401 (doc pointer)
+            from raydp_tpu.runtime.rpc import RpcClient
+            master_id = ctx.head.call("get_named_actor", master_name)
+            if master_id is not None:
+                address = ctx.head.call("get_actor_address", master_id)
+                if address is not None:
+                    master = RpcClient(tuple(address))
+                    self.executor_id = master.call(
+                        "register_executor", self._actor_name, ctx.was_restarted)
+                    master.close()
+
+    # -- control ---------------------------------------------------------------
+    def ping(self) -> str:
+        return "pong"
+
+    def crash(self) -> None:
+        """Fault injection: die abruptly (tests' node-kill analogue)."""
+        import os
+        os._exit(23)
+
+    def get_executor_id(self) -> Optional[str]:
+        return self.executor_id
+
+    # -- compute ---------------------------------------------------------------
+    def run_task(self, task_bytes: bytes) -> Dict[str, Any]:
+        """Execute one task; the return shape depends on the task's output mode."""
+        task: T.Task = cloudpickle.loads(task_bytes)
+        table = T.run_task_body(task)
+        client = get_client()
+        owner = task.owner
+
+        if task.output == T.ROWCOUNT:
+            return {"num_rows": table.num_rows}
+
+        if task.output == T.COLLECT:
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, table.schema) as w:
+                w.write_table(table)
+            return {"ipc": sink.getvalue().to_pybytes(), "num_rows": table.num_rows}
+
+        if task.output == T.CACHE:
+            assert task.cache_key is not None
+            self.cache.put(task.cache_key, table)
+            return {
+                "num_rows": table.num_rows,
+                "nbytes": table.nbytes,
+                "cache_key": task.cache_key,
+                "executor": self._actor_name,
+                "schema": table.schema.serialize().to_pybytes(),
+            }
+
+        if task.output == T.SHUFFLE:
+            if task.range_key is not None:
+                key, boundaries = task.range_key
+                buckets = T.range_buckets(table, key, boundaries)
+            elif task.shuffle_keys:
+                buckets = T.hash_buckets(table, task.shuffle_keys, task.num_buckets)
+            else:
+                start = T.hash_bytes(task.task_id) % max(task.num_buckets, 1)
+                buckets = T.round_robin_buckets(table, task.num_buckets, start)
+            refs = [client.put_arrow(b, owner=owner) for b in buckets]
+            return {
+                "bucket_refs": refs,
+                "num_rows": table.num_rows,
+                "schema": table.schema.serialize().to_pybytes(),
+            }
+
+        # default: RETURN_REF
+        ref = client.put_arrow(table, owner=owner)
+        return {
+            "ref": ref,
+            "num_rows": table.num_rows,
+            "nbytes": table.nbytes,
+            "schema": table.schema.serialize().to_pybytes(),
+        }
+
+    # -- data-plane server (parity: getRDDPartition) ---------------------------
+    def get_block(self, cache_key: str, recover_bytes: Optional[bytes] = None,
+                  owner: Optional[str] = None) -> Dict[str, Any]:
+        """Serve a cached block as an object-store ref; recompute on miss.
+
+        Parity: RayDPExecutor.scala:312-355 — BlockManager read, recache via the
+        driver agent on miss, then an Arrow IPC stream handed back through the
+        object store.
+        """
+        table = self.cache.get(cache_key)
+        if table is None:
+            if recover_bytes is None:
+                raise KeyError(f"block {cache_key} not cached and no lineage")
+            task: T.Task = cloudpickle.loads(recover_bytes)
+            table = T.run_task_body(task)
+            self.cache.put(cache_key, table)
+            logger.warning("recovered lost block %s via lineage", cache_key)
+        ref = get_client().put_arrow(table, owner=owner)
+        return {"ref": ref, "num_rows": table.num_rows}
+
+    def has_block(self, cache_key: str) -> bool:
+        return self.cache.get(cache_key) is not None
+
+    def list_blocks(self) -> List[str]:
+        return self.cache.keys()
+
+    def drop_blocks(self, keys: List[str]) -> int:
+        return self.cache.drop(keys)
+
+    def drop_block_prefix(self, prefix: str) -> int:
+        return self.cache.drop_prefix(prefix)
+
+    def cache_stats(self) -> Dict[str, Any]:
+        return {"keys": self.cache.keys(), "total_bytes": self.cache.total_bytes()}

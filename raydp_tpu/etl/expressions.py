@@ -134,9 +134,54 @@ def _wrap(v) -> Expr:
     return v if isinstance(v, Expr) else Literal(v)
 
 
-def _as_array(v, length: int):
-    """Broadcast a scalar evaluation result when needed."""
-    return v
+def _is_integer_like(v) -> bool:
+    t = v.type if isinstance(v, (pa.Array, pa.ChunkedArray, pa.Scalar)) else None
+    return t is not None and pa.types.is_integer(t)
+
+
+def _modulo(left, right):
+    """Python-semantics modulo (Arrow ships no kernel). Integers stay in int64
+    (a float64 round-trip would corrupt values beyond 2^53); division by zero
+    yields null, matching SQL/Spark."""
+    import numpy as np
+
+    if _is_integer_like(left) and _is_integer_like(right):
+        l_arr, l_null = _to_np_int(left)
+        r_arr, r_null = _to_np_int(right)
+        l_arr, r_arr = np.broadcast_arrays(l_arr, r_arr)
+        invalid = (r_arr == 0)
+        for nm in (l_null, r_null):
+            if nm is not None:
+                invalid = invalid | np.broadcast_to(nm, invalid.shape)
+        if invalid.ndim == 0:  # scalar % scalar
+            if invalid:
+                return pa.scalar(None, type=pa.int64())
+            return pa.scalar(int(np.remainder(l_arr, r_arr)), type=pa.int64())
+        safe_r = np.where(invalid, 1, r_arr)
+        out = np.remainder(l_arr, safe_r)
+        return pa.array(np.where(invalid, 0, out), type=pa.int64(),
+                        mask=invalid if invalid.any() else None)
+    quot = pc.floor(pc.divide(pc.cast(left, pa.float64(), safe=False),
+                              pc.cast(right, pa.float64(), safe=False)))
+    return pc.subtract(pc.cast(left, pa.float64(), safe=False),
+                       pc.multiply(quot, pc.cast(right, pa.float64(), safe=False)))
+
+
+def _to_np_int(v):
+    """(int64 ndarray or 0-d, null-mask ndarray or None) for an Arrow value."""
+    import numpy as np
+
+    if isinstance(v, pa.Scalar):
+        if v.as_py() is None:
+            return np.int64(0), np.bool_(True)
+        return np.int64(v.as_py()), None
+    if isinstance(v, pa.ChunkedArray):
+        v = v.combine_chunks()
+    null_mask = None
+    if v.null_count:
+        null_mask = np.asarray(pc.is_null(v))
+        v = pc.fill_null(v, 0)
+    return np.asarray(pc.cast(v, pa.int64())), null_mask
 
 
 class Column(Expr):
@@ -185,6 +230,8 @@ class BinaryOp(Expr):
     def evaluate(self, table: pa.Table):
         left = self.left.evaluate(table)
         right = self.right.evaluate(table)
+        if self.op == "mod":
+            return _modulo(left, right)
         return getattr(pc, self.op)(left, right)
 
     def _name(self) -> str:
@@ -291,6 +338,66 @@ class Func(Expr):
 
     def _name(self) -> str:
         return self.name or f"{self.fn}({', '.join(c._name() for c in self.children)})"
+
+
+class UdfExpr(Expr):
+    """A user-defined function over column expressions.
+
+    Parity: PySpark ``@udf`` as the reference's feature engineering uses it
+    (examples/data_process.py ``night``/``late_night``/``manhattan`` UDFs). The
+    function is applied per-row over the evaluated child arrays; the result is
+    cast to ``return_type``. Vectorized ``pyarrow.compute`` expressions are always
+    preferred — UDFs are the escape hatch.
+    """
+
+    def __init__(self, fn: Callable, children: List[Expr], return_type,
+                 name: Optional[str] = None):
+        self.fn = fn
+        self.children = children
+        self.return_type = return_type
+        self.name = name or getattr(fn, "__name__", "udf")
+
+    def evaluate(self, table: pa.Table):
+        cols = []
+        for c in self.children:
+            v = evaluate_to_array(c, table)
+            cols.append(v.to_pylist())
+        if not cols:
+            out = [self.fn() for _ in range(table.num_rows)]
+        else:
+            out = [self.fn(*vals) for vals in zip(*cols)]
+        return pa.array(out, type=_to_arrow_type(self.return_type))
+
+    def _name(self) -> str:
+        return self.name
+
+
+def udf(return_type="string"):
+    """``@udf("int")`` decorator; the wrapped fn accepts column names or exprs."""
+
+    def deco(fn):
+        def make(*cols):
+            children = [c if isinstance(c, Expr) else Column(c) for c in cols]
+            return UdfExpr(fn, children, return_type)
+        make.__name__ = getattr(fn, "__name__", "udf")
+        return make
+
+    if callable(return_type):  # used bare: @udf
+        fn, return_type = return_type, "string"
+        return deco(fn)
+    return deco
+
+
+class AggExpr:
+    """An aggregation spec for ``groupBy().agg(...)``: (fn, column, out name)."""
+
+    def __init__(self, fn: str, column: str, name: Optional[str] = None):
+        self.fn = fn
+        self.column = column
+        self.name = name or f"{self.fn}({column})"
+
+    def alias(self, name: str) -> "AggExpr":
+        return AggExpr(self.fn, self.column, name)
 
 
 class _DtAccessor:

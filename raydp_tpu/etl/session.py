@@ -1,0 +1,230 @@
+"""The ETL Session: the SparkSession analogue returned by ``raydp_tpu.init``.
+
+Bring-up parity (call stack §3.1 of SURVEY.md): create the master actor, then the
+executor gang — each an actor with ``{CPU, memory}`` resources, scheduled into the
+session's placement-group bundles round-robin (RayAppMaster.scala:290-303), with
+``max_restarts=-1`` (RayExecutorUtils.java:58). Teardown order parity:
+``stop(cleanup_data=False)`` keeps the master actor (and the objects it owns)
+alive so converted datasets survive the ETL engine, exactly like
+``RayDPSparkMaster.stop(cleanup_data)`` (ray_cluster_master.py:236-247).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Dict, List, Optional, Union
+
+import pandas as pd
+import pyarrow as pa
+
+from raydp_tpu import config as cfg
+from raydp_tpu.config import Config
+from raydp_tpu.etl import plan as P
+from raydp_tpu.etl.engine import Engine, ExecutorPool
+from raydp_tpu.etl.executor import EtlExecutor
+from raydp_tpu.etl.frame import DataFrame
+from raydp_tpu.etl.master import EtlMaster
+from raydp_tpu.log import get_logger
+from raydp_tpu.runtime import get_runtime
+from raydp_tpu.runtime.actor import ActorHandle
+
+logger = get_logger("etl.session")
+
+
+class Session:
+    def __init__(self, app_name: str, num_executors: int, executor_cores: int,
+                 executor_memory: int, config: Optional[Config] = None,
+                 placement_group=None):
+        self.app_name = app_name
+        self.num_executors = num_executors
+        self.executor_cores = executor_cores
+        self.executor_memory = executor_memory
+        self.config = config or Config()
+        self.placement_group = placement_group
+        self.master_name = f"{app_name}_MASTER"
+        self.master: Optional[ActorHandle] = None
+        self.executors: List[ActorHandle] = []
+        self.engine: Optional[Engine] = None
+        self._cached_frames: Dict[str, P.CachedScan] = {}
+        self._stopped = False
+
+    # ---- lifecycle ----------------------------------------------------------
+    def start(self) -> "Session":
+        rt = get_runtime()
+        master_resources = self.config.resource_map(
+            cfg.MASTER_ACTOR_RESOURCE_PREFIX)
+        self.master = rt.create_actor(
+            EtlMaster, (self.app_name,), name=self.master_name,
+            resources=master_resources, max_restarts=0, max_concurrency=8)
+
+        executor_resources = {"CPU": float(self.executor_cores),
+                              "memory": float(self.executor_memory)}
+        executor_resources.update(
+            self.config.resource_map(cfg.EXECUTOR_ACTOR_RESOURCE_PREFIX))
+        max_restarts = self.config.get_int(cfg.EXECUTOR_RESTARTS_KEY, -1)
+
+        for i in range(self.num_executors):
+            pg_id, bundle = None, None
+            if self.placement_group is not None:
+                pg_id = self.placement_group.group_id
+                bundle = i % len(self.placement_group.bundles)
+            handle = rt.create_actor(
+                EtlExecutor, (self.master_name,),
+                name=f"rdt-executor-{self.app_name}-{i}",
+                resources=executor_resources,
+                max_restarts=max_restarts,
+                max_concurrency=max(2, self.executor_cores),
+                env={"JAX_PLATFORMS": "cpu"},  # ETL actors must never grab TPU chips
+                placement_group=pg_id,
+                bundle_index=bundle,
+                block=False,
+            )
+            self.executors.append(handle)
+        for h in self.executors:
+            h.wait_ready()
+
+        pool = ExecutorPool(self.executors)
+        self.engine = Engine(
+            pool,
+            shuffle_partitions=self.config.get_int(cfg.SHUFFLE_PARTITIONS_KEY, 8),
+            owner=self.master_name,
+        )
+        logger.info("session %s started: master + %d executors",
+                    self.app_name, len(self.executors))
+        return self
+
+    def stop(self, cleanup_data: bool = True) -> None:
+        """Idempotent; a later ``stop(cleanup_data=True)`` after a keep-data stop
+        still reaps the master (parity: ray_cluster_master.py:236-247)."""
+        if not self._stopped:
+            self._stopped = True
+            for h in self.executors:
+                try:
+                    h.kill(no_restart=True)
+                except Exception:
+                    pass
+            self.executors = []
+        if cleanup_data and self.master is not None:
+            try:
+                self.master.kill(no_restart=True)
+            except Exception:
+                pass
+            self.master = None
+        logger.info("session %s stopped (cleanup_data=%s)",
+                    self.app_name, cleanup_data)
+
+    # ---- frame constructors -------------------------------------------------
+    @property
+    def read(self) -> "DataFrameReader":
+        return DataFrameReader(self)
+
+    def range(self, start: int, stop: Optional[int] = None, step: int = 1,
+              num_partitions: Optional[int] = None) -> DataFrame:
+        if stop is None:
+            start, stop = 0, start
+        n = num_partitions or max(1, min(len(self.executors),
+                                         (stop - start) // 1000 + 1))
+        return DataFrame(self, P.RangeScan(start, stop, step, n))
+
+    def createDataFrame(
+        self,
+        data: Union[pd.DataFrame, pa.Table, List[dict]],
+        num_partitions: Optional[int] = None,
+    ) -> DataFrame:
+        if isinstance(data, list):
+            table = pa.Table.from_pylist(data)
+        elif isinstance(data, pd.DataFrame):
+            table = pa.Table.from_pandas(data, preserve_index=False)
+        elif isinstance(data, pa.Table):
+            table = data
+        else:
+            raise TypeError(f"cannot create DataFrame from {type(data)}")
+        n = num_partitions or max(1, min(len(self.executors),
+                                         table.num_rows or 1))
+        from raydp_tpu.runtime.object_store import get_client
+        client = get_client()
+        rows = table.num_rows
+        per = max(1, -(-rows // n))
+        refs = []
+        for i in range(0, max(rows, 1), per):
+            chunk = table.slice(i, per)
+            refs.append(client.put_arrow(chunk, owner=self.master_name))
+        schema = table.schema.serialize().to_pybytes()
+        return DataFrame(self, P.InMemory(refs, schema), schema=table.schema)
+
+    create_frame = createDataFrame
+
+    # ---- cached-frame registry (recoverable conversions) --------------------
+    def register_cached(self, frame_id: str, cached: P.CachedScan) -> None:
+        self._cached_frames[frame_id] = cached
+
+    def release_cached(self, frame_id: str) -> None:
+        """Drop a persisted frame's blocks (parity: ``releaseRecoverableRDD``,
+        ObjectStoreWriter.scala:211-216)."""
+        cached = self._cached_frames.pop(frame_id, None)
+        if cached is None:
+            return
+        for h in self.executors:
+            try:
+                h.drop_block_prefix(f"block_{frame_id}_")
+            except Exception:
+                pass
+        if cached.pinned_refs:
+            from raydp_tpu.runtime.object_store import get_client
+            try:
+                get_client().free(cached.pinned_refs)
+            except Exception:
+                pass
+
+    def cached_frames(self) -> List[str]:
+        return list(self._cached_frames)
+
+
+class DataFrameReader:
+    def __init__(self, session: Session):
+        self._session = session
+        self._options: Dict[str, str] = {}
+
+    def option(self, key: str, value) -> "DataFrameReader":
+        self._options[key] = value
+        return self
+
+    def format(self, fmt: str) -> "DataFrameReader":
+        self._format = fmt
+        return self
+
+    def load(self, path: str) -> DataFrame:
+        fmt = getattr(self, "_format", "parquet")
+        return getattr(self, fmt)(path)
+
+    def csv(self, path: Union[str, List[str]],
+            num_partitions: Optional[int] = None) -> DataFrame:
+        paths = _expand_paths(path, (".csv",))
+        return DataFrame(self._session,
+                         P.CsvScan(paths, num_partitions=num_partitions))
+
+    def parquet(self, path: Union[str, List[str]],
+                columns: Optional[List[str]] = None) -> DataFrame:
+        """Read parquet; silently skips non-parquet files in a directory
+        (parity: reference ``read_spark_parquet`` filtering, tests/test_read_parquet.py)."""
+        paths = _expand_paths(path, (".parquet", ".pq"))
+        return DataFrame(self._session, P.ParquetScan(paths, columns=columns))
+
+
+def _expand_paths(path: Union[str, List[str]], suffixes) -> List[str]:
+    import glob
+    import os
+    if isinstance(path, list):
+        candidates = path
+    elif os.path.isdir(path):
+        candidates = sorted(glob.glob(os.path.join(path, "*")))
+        candidates = [p for p in candidates
+                      if p.endswith(suffixes) or "part-" in os.path.basename(p)]
+    else:
+        candidates = sorted(glob.glob(path)) or [path]
+    if not candidates:
+        raise FileNotFoundError(f"no input files match {path!r}")
+    for p in candidates:
+        if p.startswith("file://"):
+            raise ValueError("strip the file:// prefix; local paths only")
+    return candidates

@@ -356,8 +356,11 @@ def round_robin_buckets(table: pa.Table, num_buckets: int,
 
 
 def range_buckets(table: pa.Table, key: str, boundaries: List) -> List[pa.Table]:
+    """Partition rows by boundary values using Arrow comparisons — works for any
+    orderable type (ints, floats, strings, timestamps), no numeric cast."""
     col_arr = table.column(key).combine_chunks()
-    vals = np.asarray(pc.cast(col_arr, pa.float64(), safe=False))
-    edges = np.array(boundaries, dtype=np.float64)
-    bucket = np.searchsorted(edges, vals, side="right")
-    return [table.filter(pa.array(bucket == b)) for b in range(len(boundaries) + 1)]
+    bucket = np.zeros(table.num_rows, dtype=np.int64)
+    for b in boundaries:
+        gt = pc.fill_null(pc.greater(col_arr, pa.scalar(b)), False)
+        bucket += np.asarray(gt, dtype=np.int64)
+    return [table.filter(pa.array(bucket == i)) for i in range(len(boundaries) + 1)]

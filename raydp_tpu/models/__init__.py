@@ -1,0 +1,15 @@
+"""raydp_tpu.models — the model families exercised by the reference's examples.
+
+- :mod:`mlp` — the NYCTaxi fare-regression MLP (examples/pytorch_nyctaxi.py:69-92).
+- :mod:`dlrm` — Criteo DLRM with sharded embedding tables
+  (examples/pytorch_dlrm.ipynb: bottom MLP 512-256-64-16, 26 embeddings, top MLP).
+- :mod:`transformer` — a long-context transformer with ring attention /
+  sequence-parallel sharding (the capability the TPU build adds beyond the
+  reference's tabular models; SURVEY.md §5 long-context note).
+"""
+
+from raydp_tpu.models.mlp import MLP, NYCTaxiModel
+from raydp_tpu.models.dlrm import DLRM, criteo_batch_preprocessor, dlrm_param_rules
+
+__all__ = ["MLP", "NYCTaxiModel", "DLRM", "criteo_batch_preprocessor",
+           "dlrm_param_rules"]

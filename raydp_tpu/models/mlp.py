@@ -1,0 +1,43 @@
+"""MLP models for tabular regression/classification.
+
+``NYCTaxiModel`` mirrors the reference's fare-regression network layer for layer
+(examples/pytorch_nyctaxi.py:69-92: Linear 256→128→64→16→1 with ReLU+BatchNorm),
+expressed as Flax so XLA fuses the elementwise chain into the matmuls. bfloat16
+compute is a constructor flag — tabular widths this small are latency-bound on
+the VPU side, but bf16 halves HBM traffic on the batch and activations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Generic MLP: hidden widths, optional batch-norm, single head."""
+
+    features: Sequence[int]
+    out_features: int = 1
+    use_batch_norm: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = self.dtype or x.dtype
+        x = x.astype(dtype)
+        for width in self.features:
+            x = nn.Dense(width, dtype=dtype)(x)
+            x = nn.relu(x)
+            if self.use_batch_norm:
+                x = nn.BatchNorm(use_running_average=not train, dtype=dtype)(x)
+        x = nn.Dense(self.out_features, dtype=dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def NYCTaxiModel(dtype: Optional[jnp.dtype] = None,
+                 use_batch_norm: bool = True) -> MLP:
+    """The reference's NYC_Model topology (pytorch_nyctaxi.py:69-92)."""
+    return MLP(features=(256, 128, 64, 16), out_features=1,
+               use_batch_norm=use_batch_norm, dtype=dtype)

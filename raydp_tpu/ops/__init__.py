@@ -1,0 +1,13 @@
+"""raydp_tpu.ops — TPU kernels and collective ops.
+
+The reference has no custom kernels (its compute is Spark + torch CPU ops); this
+package is where the TPU build spends its hardware budget: ring attention for
+sequence parallelism (:mod:`ring_attention`), and pallas flash-attention blocks
+(:mod:`flash_attention`) for the local computation. Long-context is first-class:
+the ring pattern streams K/V blocks around the ``seq`` axis over ICI while each
+step's local attention runs on the MXU, overlapping transfer with compute.
+"""
+
+from raydp_tpu.ops.ring_attention import ring_attention, ring_attention_sharded
+
+__all__ = ["ring_attention", "ring_attention_sharded"]

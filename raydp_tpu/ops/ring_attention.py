@@ -1,0 +1,137 @@
+"""Ring attention: exact attention over sequence-sharded inputs.
+
+The sequence axis is sharded over the mesh's ``seq`` axis; each device holds a
+[B, T/n, H, D] slice of Q/K/V. K/V blocks rotate around the ring with
+``ppermute`` while every device accumulates its queries' attention over each
+passing block using the online-softmax (flash) recurrence, so the full [T, T]
+score matrix never materializes and memory stays O(T/n). Collectives ride ICI
+neighbor links — the layout the hardware gives ring ``ppermute`` for free.
+
+The reference framework has no sequence parallelism at all (SURVEY.md §2.4: "every
+other strategy is absent") — this op is the long-context capability the TPU build
+adds. Local block attention dispatches to the pallas flash kernel on TPU
+(:mod:`raydp_tpu.ops.flash_attention`) and to a fused jnp path elsewhere.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _local_attention_update(q, k, v, m, l, acc, mask=None, scale=1.0):
+    """One online-softmax update of (m, l, acc) with a new K/V block.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]; m, l: [B, H, Tq]; acc: [B, Tq, H, D].
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B, H, Tq, Tk]
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    m_blk = jnp.max(scores, axis=-1)                      # [B, H, Tq]
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked rows: exp(-inf - -inf) -> exp(0) would be wrong
+    safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(scores - safe_m[..., None])
+    p = jnp.where(jnp.isneginf(scores), 0.0, p)
+    correction = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    acc_new = acc * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
+                   scale: Optional[float] = None):
+    """Exact attention for sequence-sharded q/k/v; call inside ``shard_map``.
+
+    Shapes per device: q, k, v = [B, T_local, H, D]. Returns [B, T_local, H, D].
+    """
+    axis_size = lax.psum(1, axis_name)
+    my_index = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    q_positions = my_index * t_local + jnp.arange(t_local)  # global q positions
+
+    m0 = jnp.full((b, h, t_local), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), dtype=jnp.float32)
+    acc0 = jnp.zeros((b, t_local, h, d), dtype=jnp.float32)
+    if hasattr(lax, "pvary"):
+        # newer jax tracks varying-manual-axes through shard_map: the carry
+        # inits must vary over the same axes as the inputs they mix with
+        try:
+            vma = tuple(jax.typeof(q).vma) or (axis_name,)
+        except Exception:
+            vma = (axis_name,)
+        m0, l0, acc0 = (lax.pvary(x, vma) for x in (m0, l0, acc0))
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, step_idx):
+        m, l, acc, k_blk, v_blk = carry
+        # the block currently on this device originated at (my_index - step)
+        src = (my_index - step_idx) % axis_size
+        k_positions = src * t_local + jnp.arange(t_local)
+        if causal:
+            mask = q_positions[:, None] >= k_positions[None, :]  # [Tq, Tk]
+            mask = mask[None, None, :, :]
+        else:
+            mask = None
+        m, l, acc = _local_attention_update(
+            q.astype(jnp.float32), k_blk.astype(jnp.float32),
+            v_blk.astype(jnp.float32), m, l, acc, mask=mask, scale=scale)
+        # rotate K/V to the next neighbor (overlaps with next local compute
+        # when XLA schedules the collective-permute asynchronously)
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (m, l, acc, k_next, v_next), None
+
+    (m, l, acc, _, _), _ = lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(axis_size))
+    l = jnp.maximum(l, 1e-20)
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, causal: bool = True,
+                           seq_axis: str = "seq", batch_axes=("data", "fsdp"),
+                           head_axis: str = "tensor"):
+    """shard_map wrapper: [B, T, H, D] arrays sharded (batch over data axes,
+    sequence over ``seq_axis``, heads over ``head_axis`` when present) → same
+    sharding out. Ring + head sharding compose: each (seq, tensor) tile ships
+    only its own heads' K/V around the ring."""
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    batch = tuple(a for a in batch_axes if a in mesh.axis_names
+                  and mesh.shape[a] > 1)
+    bspec = batch if len(batch) > 1 else (batch[0] if batch else None)
+    hspec = head_axis if (head_axis in mesh.axis_names
+                          and mesh.shape[head_axis] > 1) else None
+    spec = P(bspec, seq_axis, hspec, None)
+
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)
+
+
+def dense_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None):
+    """Unsharded reference implementation (for tests and single-device use)."""
+    b, t, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,29 @@
+"""raydp_tpu.parallel — meshes, shardings, and collectives.
+
+The reference's parallelism inventory is DP-only, realized through five different
+collective stacks (SURVEY.md §2.4-2.5: torch DDP, oneCCL, TF MWMS, Horovod,
+XGBoost Rabit). The TPU-native design collapses all of them into one mechanism:
+a ``jax.sharding.Mesh`` over the pod plus in-graph XLA collectives inserted by
+``jit`` from sharding annotations — gradients ride ICI ``psum``, not NCCL rings.
+The mesh here is multi-axis from day one (``data``/``fsdp``/``tensor``/``seq``/
+``expert``) so TP/FSDP/sequence/expert sharding are additive strategies, not
+rewrites (SURVEY.md §2.4 closing note).
+"""
+
+from raydp_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    batch_sharding,
+    replicated,
+    param_sharding_rules,
+    shard_params,
+)
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "batch_sharding",
+    "replicated",
+    "param_sharding_rules",
+    "shard_params",
+]

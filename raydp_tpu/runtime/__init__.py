@@ -8,7 +8,7 @@ natively, designed for the TPU process model (one JAX process owns a host's chip
 so placement is host-granular):
 
 - :mod:`rpc` — length-prefixed cloudpickle request/response over TCP.
-- :mod:`object_store` — shared-memory Arrow object store with ownership + refcounts.
+- :mod:`object_store` — shared-memory Arrow object store with object ownership.
 - :mod:`actor` — actor processes, handles, named lookup, restart protocol.
 - :mod:`head` — driver-side control plane: registry, nodes, placement groups.
 """

@@ -75,12 +75,6 @@ class HeadService:
     def store_contains(self, *a):
         return self._rt.store_server.contains(*a)
 
-    def store_add_ref(self, *a):
-        return self._rt.store_server.add_ref(*a)
-
-    def store_remove_ref(self, *a):
-        return self._rt.store_server.remove_ref(*a)
-
     def store_free(self, *a):
         return self._rt.store_server.free(*a)
 
@@ -286,23 +280,23 @@ class RuntimeContext:
                 existing = self.records.get(self.names[spec.name])
                 if existing is not None and existing.state != DEAD:
                     raise ValueError(f"actor name {spec.name!r} already taken")
-            pinned_node = spec.node_id
             if spec.placement_group_id is not None and spec.bundle_index is not None:
+                # bundle resources were pre-reserved at group creation: run on
+                # the bundle's node without charging the node a second time
+                # (parity: actors scheduled *into* bundles, context.py:119-140)
                 group = self.resource_manager.get_group(spec.placement_group_id)
                 if group is None:
-                    raise ValueError(f"unknown placement group {spec.placement_group_id}")
-                pinned_node = group.bundle_node(spec.bundle_index)
-            node_id = self.resource_manager.allocate(spec.resources, pinned_node)
-            if node_id is None and spec.placement_group_id is not None:
-                # bundle resources were pre-reserved by the group: run there without
-                # double-charging the node (parity: actors scheduled *into* bundles)
-                node_id = pinned_node
+                    raise ValueError(
+                        f"unknown placement group {spec.placement_group_id}")
+                node_id = group.bundle_node(spec.bundle_index)
                 held: Dict[str, float] = {}
-            elif node_id is None:
-                raise ValueError(
-                    f"cannot place actor {spec.name or spec.actor_id}: "
-                    f"resources {spec.resources} not available")
             else:
+                node_id = self.resource_manager.allocate(spec.resources,
+                                                         spec.node_id)
+                if node_id is None:
+                    raise ValueError(
+                        f"cannot place actor {spec.name or spec.actor_id}: "
+                        f"resources {spec.resources} not available")
                 held = dict(spec.resources)
             rec = ActorRecord(spec=spec, node_id=node_id, resources_held=held)
             self.records[spec.actor_id] = rec
@@ -393,14 +387,13 @@ class RuntimeContext:
                         rec.restart_count += 1
                         rec.was_restarted = True
                         rec.state = RESTARTING
-                        node_id = self.resource_manager.allocate(
-                            rec.spec.resources, rec.spec.node_id)
+                        node_id, held = self._replacement_node(rec)
                         if node_id is None:
                             # leave RESTARTING: retried next tick (pending resources)
                             rec.process = None
                             continue
                         rec.node_id = node_id
-                        rec.resources_held = dict(rec.spec.resources)
+                        rec.resources_held = held
                         logger.warning(
                             "actor %s exited with code %s; restarting (attempt %d)",
                             rec.spec.name or actor_id, code, rec.restart_count)
@@ -415,13 +408,26 @@ class RuntimeContext:
             with self._lock:
                 for rec in self.records.values():
                     if rec.state == RESTARTING and rec.process is None:
-                        node_id = self.resource_manager.allocate(
-                            rec.spec.resources, rec.spec.node_id)
+                        node_id, held = self._replacement_node(rec)
                         if node_id is not None:
                             rec.node_id = node_id
-                            rec.resources_held = dict(rec.spec.resources)
+                            rec.resources_held = held
                             self._spawn(rec)
             time.sleep(0.1)
+
+    def _replacement_node(self, rec: ActorRecord):
+        """Node for a restarting actor: its placement-group bundle if the group
+        (and that node) is still alive, else a fresh allocation."""
+        spec = rec.spec
+        if spec.placement_group_id is not None and spec.bundle_index is not None:
+            group = self.resource_manager.get_group(spec.placement_group_id)
+            if group is not None:
+                node_id = group.bundle_node(spec.bundle_index)
+                node = self.resource_manager.get_node(node_id) if node_id else None
+                if node is not None and node.alive:
+                    return node_id, {}
+        node_id = self.resource_manager.allocate(spec.resources, spec.node_id)
+        return node_id, (dict(spec.resources) if node_id is not None else {})
 
     # ---- nodes --------------------------------------------------------------
     def remove_node(self, node_id: str) -> None:

@@ -2,7 +2,7 @@
 
 The reference's data plane is Ray's plasma store: Spark executors serialize Arrow IPC
 partitions into shared memory, Python training workers map them zero-copy, and an
-ownership/refcount protocol decides lifetime (SURVEY.md §2.5; reference
+ownership protocol decides lifetime (SURVEY.md §2.5; reference
 RayDPUtils.java:45-53 ``readBinary`` is the zero-copy handoff kernel;
 dataset.py:137-158 transfers object ownership to the master actor so data outlives
 Spark). This module provides the native equivalent:
@@ -10,7 +10,7 @@ Spark). This module provides the native equivalent:
 - every object is one POSIX shared-memory segment (``/dev/shm``), written once and
   sealed; readers attach and get a zero-copy ``memoryview``;
 - a metadata server (thread in the head process) keeps the object table:
-  ``id -> (segment, size, kind, owner, refcount)``;
+  ``id -> (segment, size, kind, owner)``;
 - objects are *owned*: when their owning actor dies un-transferred, they are freed;
   ``transfer_ownership`` re-homes them (parity with ``get_raydp_master_owner``,
   dataset.py:137-158);
@@ -66,7 +66,6 @@ class _Entry:
     size: int
     kind: str
     owner: str
-    refcount: int = 0
     sealed: bool = True
 
 
@@ -101,27 +100,9 @@ class ObjectStoreServer:
         with self._lock:
             return object_id in self._table
 
-    # -- lifetime -------------------------------------------------------------
-    def add_ref(self, object_ids: List[str]) -> None:
-        with self._lock:
-            for oid in object_ids:
-                e = self._table.get(oid)
-                if e is not None:
-                    e.refcount += 1
-
-    def remove_ref(self, object_ids: List[str]) -> None:
-        freed = []
-        with self._lock:
-            for oid in object_ids:
-                e = self._table.get(oid)
-                if e is not None:
-                    e.refcount -= 1
-                    if e.refcount <= 0 and e.owner is None:
-                        freed.append((oid, e.segment))
-                        del self._table[oid]
-        for _, seg in freed:
-            _unlink_segment(seg)
-
+    # -- lifetime: ownership-based (owner death sweeps; explicit free releases).
+    # A refcount protocol is deliberately absent — every object has exactly one
+    # owner and lineage makes re-creation cheap, so ownership is the whole story.
     def free(self, object_ids: List[str]) -> int:
         """Explicitly delete objects regardless of owner (release path,
         parity with ``release_spark_recoverable``, dataset.py:224-237)."""

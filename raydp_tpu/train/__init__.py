@@ -1,0 +1,23 @@
+"""raydp_tpu.train — the JAX/XLA training tier (L5 Estimator parity).
+
+The reference's L5 is three sklearn-style estimators over Ray Train
+(torch/estimator.py, tf/estimator.py, xgboost/estimator.py) sharing the shape
+``fit`` / ``fit_on_spark`` / ``get_model`` (estimator.py:23-43,
+spark/interfaces.py:27-39). Here the training engine is pjit-compiled SPMD over a
+device mesh: the DDP wrap + per-step torch.distributed allreduce
+(torch/estimator.py:243,272-293) become sharding annotations — XLA emits the
+gradient ``psum`` over ICI.
+"""
+
+from raydp_tpu.train.estimator import EstimatorInterface, FrameEstimatorInterface
+from raydp_tpu.train.flax_estimator import FlaxEstimator, TrainingResult
+from raydp_tpu.train.metrics import Metric, build_metrics
+
+__all__ = [
+    "EstimatorInterface",
+    "FrameEstimatorInterface",
+    "FlaxEstimator",
+    "TrainingResult",
+    "Metric",
+    "build_metrics",
+]

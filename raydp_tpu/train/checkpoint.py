@@ -1,0 +1,70 @@
+"""Checkpoint save/restore via orbax (parity: Ray Train Checkpoint usage,
+torch/estimator.py:259-270, 392-396 — rank-0 writes, ``get_model`` rehydrates).
+
+Only process 0 writes (chief-only, tf/estimator.py:202-210). Checkpoints are
+``step_<n>`` subdirectories; ``restore`` picks the latest complete one. Unlike the
+reference (no mid-training resume, SURVEY.md §5), a restored state resumes the
+epoch loop where it left off.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Optional, Tuple
+
+from raydp_tpu.log import get_logger
+
+logger = get_logger("train.checkpoint")
+
+_KEEP = 2
+
+
+def _step_dirs(ckpt_dir: str):
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for name in os.listdir(ckpt_dir):
+        if name.startswith("step_"):
+            try:
+                out.append((int(name.split("_", 1)[1]), os.path.join(ckpt_dir, name)))
+            except ValueError:
+                pass
+    return sorted(out)
+
+
+def save(ckpt_dir: str, state: Any, step: int) -> Optional[str]:
+    import jax
+
+    if jax.process_index() != 0:
+        return None
+    import orbax.checkpoint as ocp
+
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, jax.device_get(state))
+    # retention: keep the newest _KEEP
+    steps = _step_dirs(ckpt_dir)
+    for _, old in steps[:-_KEEP]:
+        shutil.rmtree(old, ignore_errors=True)
+    return path
+
+
+def restore(ckpt_dir: str, template: Any) -> Optional[Tuple[Any, int]]:
+    """Restore the latest checkpoint into the structure of ``template``.
+
+    Returns ``(state, step)`` or None if no checkpoint exists.
+    """
+    import jax
+    import orbax.checkpoint as ocp
+
+    steps = _step_dirs(ckpt_dir)
+    if not steps:
+        return None
+    step, path = steps[-1]
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restored = ckptr.restore(path, item=jax.device_get(template))
+    return restored, step

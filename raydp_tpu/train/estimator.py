@@ -1,0 +1,32 @@
+"""Estimator ABCs (parity: reference estimator.py:23-43 + spark/interfaces.py:27-39)."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Optional
+
+
+class EstimatorInterface(ABC):
+    """``fit`` over datasets + ``get_model`` (reference estimator.py:23-43)."""
+
+    @abstractmethod
+    def fit(self, train_ds, evaluate_ds=None, max_retries: int = 0):
+        ...
+
+    @abstractmethod
+    def get_model(self):
+        ...
+
+
+class FrameEstimatorInterface(ABC):
+    """``fit_on_frame`` — the ``fit_on_spark`` analogue
+    (spark/interfaces.py:27-39): accepts ETL DataFrames, converts through the
+    data plane (object store or a parquet spill directory), optionally stops the
+    ETL engine after conversion with ownership transferred to the master."""
+
+    @abstractmethod
+    def fit_on_frame(self, train_df, evaluate_df=None, *,
+                     fs_directory: Optional[str] = None,
+                     stop_etl_after_conversion: bool = False,
+                     max_retries: int = 0):
+        ...

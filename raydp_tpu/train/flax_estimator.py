@@ -1,0 +1,413 @@
+"""FlaxEstimator: the TorchEstimator-parity trainer, pjit-compiled for TPU.
+
+Parity map (reference torch/estimator.py):
+
+- model/optimizer/loss as instances **or** creator callables (177-220) — here a
+  Flax module (or creator), an optax transformation (or creator), and a loss
+  callable or name.
+- ``fit``: per-epoch train/evaluate loops with metric reporting (272-310) — here
+  one jitted SPMD step; the DDP wrap + allreduce (243) is replaced by sharding
+  annotations: batch sharded over the mesh's data axes, params replicated (or
+  fsdp-sharded), XLA inserting the gradient ``psum`` over ICI.
+- rank-0 checkpoint per epoch via Ray Train Checkpoint (259-270) — here orbax,
+  saved by process 0.
+- ``fit(..., max_retries)`` / ``FailureConfig`` (312-356) — here the epoch loop
+  resumes from the last orbax checkpoint on failure, which is *stronger* than the
+  reference's replay-from-scratch (SURVEY.md §5 checkpoint/resume gap).
+- ``fit_on_spark`` with object-store or parquet-spill conversion and optional
+  ``stop_spark_after_conversion`` + ownership transfer (358-390) —
+  ``fit_on_frame`` below mirrors all three.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from raydp_tpu.log import get_logger
+from raydp_tpu.train.estimator import EstimatorInterface, FrameEstimatorInterface
+from raydp_tpu.train.metrics import Metric, build_metrics
+
+logger = get_logger("train.flax_estimator")
+
+
+@dataclass
+class TrainingResult:
+    state: Any
+    history: List[Dict[str, float]] = field(default_factory=list)
+    checkpoint_dir: Optional[str] = None
+
+    @property
+    def final_metrics(self) -> Dict[str, float]:
+        return self.history[-1] if self.history else {}
+
+
+def _resolve_loss(loss) -> Callable:
+    import jax.numpy as jnp
+
+    if callable(loss):
+        return loss
+    name = (loss or "mse").lower()
+
+    def mse(preds, labels):
+        return jnp.mean((preds - labels) ** 2)
+
+    def mae(preds, labels):
+        return jnp.mean(jnp.abs(preds - labels))
+
+    def smooth_l1(preds, labels, beta=1.0):
+        # parity: the reference's NYCTaxi example trains with SmoothL1Loss
+        # (examples/pytorch_nyctaxi.py:69-105)
+        d = jnp.abs(preds - labels)
+        return jnp.mean(jnp.where(d < beta, 0.5 * d * d / beta, d - 0.5 * beta))
+
+    def bce_with_logits(logits, labels):
+        return jnp.mean(jnp.clip(logits, 0) - logits * labels
+                        + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+    def softmax_cross_entropy(logits, labels):
+        import optax
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels.astype(jnp.int32)).mean()
+
+    table = {"mse": mse, "l2": mse, "mae": mae, "l1": mae,
+             "smooth_l1": smooth_l1, "huber": smooth_l1,
+             "bce": bce_with_logits, "bce_with_logits": bce_with_logits,
+             "cross_entropy": softmax_cross_entropy}
+    if name not in table:
+        raise ValueError(f"unknown loss {name!r}; have {sorted(table)}")
+    return table[name]
+
+
+class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
+    def __init__(
+        self,
+        model=None,
+        model_creator: Optional[Callable] = None,
+        optimizer=None,
+        optimizer_creator: Optional[Callable] = None,
+        loss: Union[str, Callable, None] = "mse",
+        feature_columns: Optional[Sequence[str]] = None,
+        label_column: Optional[str] = None,
+        batch_size: int = 64,
+        num_epochs: int = 10,
+        mesh=None,
+        mesh_spec=None,
+        metrics: Optional[Sequence[Union[str, Metric]]] = None,
+        checkpoint_dir: Optional[str] = None,
+        seed: int = 0,
+        feature_dtype=np.float32,
+        label_dtype=np.float32,
+        shuffle: bool = True,
+        param_rules=None,
+        batch_preprocessor: Optional[Callable] = None,
+        columns_spec: Optional[Dict] = None,
+        compute_dtype=None,
+        drop_last: bool = True,
+        callbacks: Optional[Sequence[Callable[[Dict], None]]] = None,
+    ):
+        if model is None and model_creator is None:
+            raise ValueError("pass model or model_creator")
+        self._model = model
+        self._model_creator = model_creator
+        self._optimizer = optimizer
+        self._optimizer_creator = optimizer_creator
+        self._loss = loss
+        self.feature_columns = list(feature_columns or [])
+        self.label_column = label_column
+        self.batch_size = batch_size
+        self.num_epochs = num_epochs
+        self._mesh = mesh
+        self._mesh_spec = mesh_spec
+        self._metrics = build_metrics(metrics or [])
+        self.checkpoint_dir = checkpoint_dir
+        self.seed = seed
+        self.feature_dtype = feature_dtype
+        self.label_dtype = label_dtype
+        self.shuffle = shuffle
+        self.param_rules = param_rules
+        self.batch_preprocessor = batch_preprocessor
+        self.columns_spec = columns_spec
+        self.compute_dtype = compute_dtype
+        self.drop_last = drop_last
+        self.callbacks = list(callbacks or [])
+        self._result: Optional[TrainingResult] = None
+
+    # ------------------------------------------------------------------ build
+    def _build_model(self):
+        return self._model if self._model is not None else self._model_creator()
+
+    def _build_optimizer(self):
+        import optax
+        if self._optimizer is not None:
+            return self._optimizer
+        if self._optimizer_creator is not None:
+            return self._optimizer_creator()
+        return optax.adam(1e-3)
+
+    def _build_mesh(self):
+        if self._mesh is not None:
+            return self._mesh
+        from raydp_tpu.parallel import make_mesh
+        return make_mesh(self._mesh_spec)
+
+    def _columns(self) -> Dict:
+        if self.columns_spec is not None:
+            return self.columns_spec
+        if not self.feature_columns or self.label_column is None:
+            raise ValueError("pass feature_columns + label_column or columns_spec")
+        return {
+            "features": (self.feature_columns, self.feature_dtype),
+            "label": (self.label_column, self.label_dtype),
+        }
+
+    def _split_batch(self, batch: Dict):
+        if self.batch_preprocessor is not None:
+            return self.batch_preprocessor(batch)
+        return batch["features"], batch["label"]
+
+    # -------------------------------------------------------------------- fit
+    def fit(self, train_ds, evaluate_ds=None, max_retries: int = 0
+            ) -> TrainingResult:
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from flax.training import train_state
+
+        from raydp_tpu.data.feed import DeviceFeed
+        from raydp_tpu.parallel import batch_sharding, param_sharding_rules
+        from raydp_tpu.train import checkpoint as ckpt
+
+        mesh = self._build_mesh()
+        model = self._build_model()
+        tx = self._build_optimizer()
+        loss_fn = _resolve_loss(self._loss)
+        metrics = self._metrics
+        columns = self._columns()
+
+        ckpt_dir = self.checkpoint_dir or tempfile.mkdtemp(prefix="rdt-ckpt-")
+
+        feed = DeviceFeed(train_ds, self.batch_size, columns, mesh=mesh,
+                          shuffle=self.shuffle, seed=self.seed,
+                          drop_remainder=self.drop_last)
+        eval_feed = None
+        if evaluate_ds is not None:
+            # a ragged final batch cannot shard over a >1 data axis; drop it
+            # there (static shapes also avoid one extra XLA compile)
+            from raydp_tpu.parallel.mesh import data_axes
+            dp_total = int(np.prod([mesh.shape[a] for a in data_axes(mesh)]))
+            eval_feed = DeviceFeed(evaluate_ds, self.batch_size, columns,
+                                   mesh=mesh, shuffle=False,
+                                   drop_remainder=dp_total > 1)
+
+        # ---- init params from one host batch's shapes ----
+        import inspect
+
+        first = next(iter(feed.host_iter))
+        inputs0, _ = self._split_batch(
+            {k: jnp.asarray(v[:1]) for k, v in first.items()})
+        rng = jax.random.PRNGKey(self.seed)
+        takes_train = False
+        try:
+            takes_train = "train" in inspect.signature(
+                type(model).__call__).parameters
+        except (TypeError, ValueError):
+            pass
+        init_kwargs = {"train": False} if takes_train else {}
+        variables = model.init(rng, inputs0, **init_kwargs)
+        batch_stats = variables.get("batch_stats")
+
+        class _State(train_state.TrainState):
+            # models with BatchNorm carry running stats beside params
+            batch_stats: Any = None
+
+        state = _State.create(
+            apply_fn=model.apply, params=variables["params"], tx=tx,
+            batch_stats=batch_stats)
+
+        shardings_of = param_sharding_rules(mesh, self.param_rules)
+        state_sharding = shardings_of(state)
+        state = jax.tree.map(
+            lambda x, s: jax.device_put(x, s), state, state_sharding,
+            is_leaf=lambda x: x is None)
+        b_sharding = batch_sharding(mesh)
+
+        compute_dtype = self.compute_dtype
+        split_batch = self._split_batch
+
+        def _cast_inputs(inputs):
+            if compute_dtype is None:
+                return inputs
+            return jax.tree.map(
+                lambda a: a.astype(compute_dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, inputs)
+
+        def _apply(params, bstats, batch, train: bool):
+            inputs, labels = split_batch(batch)
+            inputs = _cast_inputs(inputs)
+            variables = {"params": params}
+            kwargs = {"train": train} if takes_train else {}
+            if bstats is not None:
+                variables["batch_stats"] = bstats
+                if train:
+                    preds, updates = model.apply(
+                        variables, inputs, mutable=["batch_stats"], **kwargs)
+                    new_bstats = updates["batch_stats"]
+                else:
+                    preds = model.apply(variables, inputs, **kwargs)
+                    new_bstats = bstats
+            else:
+                preds = model.apply(variables, inputs, **kwargs)
+                new_bstats = None
+            if preds.ndim == labels.ndim + 1 and preds.shape[-1] == 1:
+                preds = preds.squeeze(-1)
+            return preds.astype(jnp.float32), labels, new_bstats
+
+        def train_step(state, batch, mstats):
+            def _loss(params):
+                preds, labels, new_bstats = _apply(
+                    params, state.batch_stats, batch, train=True)
+                return loss_fn(preds, labels), (preds, new_bstats)
+
+            (loss_val, (preds, new_bstats)), grads = jax.value_and_grad(
+                _loss, has_aux=True)(state.params)
+            new_state = state.apply_gradients(grads=grads)
+            if new_bstats is not None:
+                new_state = new_state.replace(batch_stats=new_bstats)
+            _, labels = split_batch(batch)
+            new_mstats = tuple(
+                m.update(s, preds, labels) for m, s in zip(metrics, mstats))
+            return new_state, loss_val, new_mstats
+
+        def eval_step(state, batch, mstats):
+            preds, labels, _ = _apply(state.params, state.batch_stats, batch,
+                                      train=False)
+            loss_val = loss_fn(preds, labels)
+            new_mstats = tuple(
+                m.update(s, preds, labels) for m, s in zip(metrics, mstats))
+            return loss_val, labels.shape[0], new_mstats
+
+        jit_train = jax.jit(train_step, donate_argnums=(0,))
+        jit_eval = jax.jit(eval_step)
+
+        history: List[Dict[str, float]] = []
+        epoch = 0
+        retries = 0
+        while epoch < self.num_epochs:
+            try:
+                t0 = time.perf_counter()
+                feed.set_epoch(epoch)
+                mstats = tuple(m.init() for m in metrics)
+                losses, steps, samples = [], 0, 0
+                for batch in feed:
+                    state, loss_val, mstats = jit_train(state, batch, mstats)
+                    losses.append(loss_val)
+                    steps += 1
+                    samples += self.batch_size
+                dt = time.perf_counter() - t0
+                report = {
+                    "epoch": epoch,
+                    "train_loss": float(jnp.mean(jnp.stack(losses))) if losses
+                    else float("nan"),
+                    "steps": steps,
+                    "samples_per_s": samples / dt if dt > 0 else 0.0,
+                    "epoch_time_s": dt,
+                }
+                for m, s in zip(metrics, mstats):
+                    report[f"train_{m.name}"] = m.compute(
+                        jax.tree.map(np.asarray, s))
+
+                if eval_feed is not None:
+                    estats = tuple(m.init() for m in metrics)
+                    elosses, ecount = [], 0
+                    for batch in eval_feed:
+                        l, n, estats = jit_eval(state, batch, estats)
+                        elosses.append(float(l) * int(n))
+                        ecount += int(n)
+                    report["eval_loss"] = (sum(elosses) / ecount) if ecount else \
+                        float("nan")
+                    for m, s in zip(metrics, estats):
+                        report[f"eval_{m.name}"] = m.compute(
+                            jax.tree.map(np.asarray, s))
+
+                history.append(report)
+                for cb in self.callbacks:
+                    cb(report)
+                logger.info("epoch %d: %s", epoch,
+                            {k: (round(v, 5) if isinstance(v, float) else v)
+                             for k, v in report.items()})
+                ckpt.save(ckpt_dir, state, step=epoch)
+                epoch += 1
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 - retry path (FailureConfig)
+                retries += 1
+                if retries > max_retries:
+                    raise
+                logger.warning("epoch %d failed (%s); restoring from checkpoint "
+                               "(retry %d/%d)", epoch, e, retries, max_retries)
+                restored = ckpt.restore(ckpt_dir, state)
+                if restored is not None:
+                    state, epoch = restored
+                    epoch += 1
+
+        self._result = TrainingResult(state=state, history=history,
+                                      checkpoint_dir=ckpt_dir)
+        return self._result
+
+    # ----------------------------------------------------------- fit_on_frame
+    def fit_on_frame(self, train_df, evaluate_df=None, *,
+                     fs_directory: Optional[str] = None,
+                     stop_etl_after_conversion: bool = False,
+                     max_retries: int = 0) -> TrainingResult:
+        import raydp_tpu
+        from raydp_tpu.data import from_frame, from_frame_recoverable
+
+        def convert(df, tag):
+            if df is None:
+                return None
+            if fs_directory is not None:
+                # parquet spill path (parity: torch/estimator.py:365-376)
+                path = os.path.join(fs_directory, tag)
+                df.write.parquet(path)
+                session = df._session
+                return from_frame(session.read.parquet(path))
+            return from_frame_recoverable(df)
+
+        train_ds = convert(train_df, "train")
+        eval_ds = convert(evaluate_df, "eval")
+
+        if stop_etl_after_conversion:
+            # parity: stop_spark_after_conversion + ownership transfer
+            # (torch/estimator.py:387-388, dataset.py:137-158)
+            train_ds.transfer_to_master()
+            if eval_ds is not None:
+                eval_ds.transfer_to_master()
+            raydp_tpu.stop(cleanup_data=False)
+
+        if self.shuffle:
+            # parity: random_shuffle before training (torch/estimator.py:335-338)
+            train_ds = train_ds.random_shuffle(seed=self.seed)
+        return self.fit(train_ds, eval_ds, max_retries=max_retries)
+
+    # -------------------------------------------------------------- get_model
+    def get_model(self):
+        """Trained Flax variables (parity: get_model from checkpoint,
+        torch/estimator.py:392-396)."""
+        if self._result is None:
+            raise RuntimeError("call fit()/fit_on_frame() first")
+        out = {"params": self._result.state.params}
+        bstats = getattr(self._result.state, "batch_stats", None)
+        if bstats is not None:
+            out["batch_stats"] = bstats
+        return out
+
+    def get_state(self):
+        if self._result is None:
+            raise RuntimeError("call fit()/fit_on_frame() first")
+        return self._result.state

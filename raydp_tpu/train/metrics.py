@@ -1,0 +1,93 @@
+"""Training metrics (parity: the torchmetrics wrapper, torch/torch_metrics.py).
+
+The reference wraps torchmetrics objects with per-epoch update/compute/reset
+(torch_metrics.py:21-55). Here each metric is a pair of pure functions so the
+update runs *inside* the jitted step (no host sync per batch): ``update`` maps a
+batch's (predictions, labels) to summable statistics, ``compute`` turns the
+accumulated statistics into the final value on the host at epoch end.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Metric:
+    name: str = "metric"
+
+    def init(self) -> Dict[str, float]:
+        return {"sum": 0.0, "count": 0.0}
+
+    def update(self, stats, preds, labels):
+        raise NotImplementedError
+
+    def compute(self, stats) -> float:
+        return float(stats["sum"] / np.maximum(stats["count"], 1e-12))
+
+
+class MSE(Metric):
+    name = "mse"
+
+    def update(self, stats, preds, labels):
+        err = jnp.sum((preds - labels) ** 2)
+        return {"sum": stats["sum"] + err, "count": stats["count"] + labels.size}
+
+
+class RMSE(MSE):
+    name = "rmse"
+
+    def compute(self, stats) -> float:
+        return float(np.sqrt(stats["sum"] / np.maximum(stats["count"], 1e-12)))
+
+
+class MAE(Metric):
+    name = "mae"
+
+    def update(self, stats, preds, labels):
+        err = jnp.sum(jnp.abs(preds - labels))
+        return {"sum": stats["sum"] + err, "count": stats["count"] + labels.size}
+
+
+class Accuracy(Metric):
+    name = "accuracy"
+
+    def update(self, stats, preds, labels):
+        if preds.ndim > labels.ndim:
+            pred_cls = jnp.argmax(preds, axis=-1)
+        else:
+            pred_cls = (preds > 0.5).astype(jnp.int32)
+        hits = jnp.sum((pred_cls == labels.astype(pred_cls.dtype)).astype(jnp.float32))
+        return {"sum": stats["sum"] + hits, "count": stats["count"] + labels.shape[0]}
+
+
+class BinaryCrossEntropy(Metric):
+    name = "bce"
+
+    def update(self, stats, preds, labels):
+        p = jnp.clip(preds, 1e-7, 1 - 1e-7)
+        ll = -jnp.sum(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p))
+        return {"sum": stats["sum"] + ll, "count": stats["count"] + labels.size}
+
+
+_REGISTRY = {m.name: m for m in (MSE(), RMSE(), MAE(), Accuracy(),
+                                 BinaryCrossEntropy())}
+_REGISTRY["mean_squared_error"] = _REGISTRY["mse"]
+_REGISTRY["mean_absolute_error"] = _REGISTRY["mae"]
+
+
+def build_metrics(specs: Sequence[Union[str, Metric]]) -> List[Metric]:
+    """Accept names or instances (parity: torch_metrics.py name-or-instance)."""
+    out: List[Metric] = []
+    for s in specs or []:
+        if isinstance(s, Metric):
+            out.append(s)
+        elif isinstance(s, str):
+            if s not in _REGISTRY:
+                raise ValueError(f"unknown metric {s!r}; have {sorted(_REGISTRY)}")
+            out.append(_REGISTRY[s])
+        else:
+            raise TypeError(f"metric spec must be str or Metric, got {type(s)}")
+    return out

@@ -1,0 +1,70 @@
+"""DLRM on a dp×expert mesh: embedding tables sharded, end-to-end fit
+(parity target: examples/pytorch_dlrm.ipynb pipeline on Ray Train)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+NUM_DENSE = 4
+CAT_SIZES = [40, 16, 24, 8, 32, 48]  # 6 tables (downscaled Criteo shape)
+
+
+def _criteo_like(session, n=2048):
+    rng = np.random.RandomState(0)
+    data = {"_c0": rng.randint(0, 2, n).astype(np.float64)}
+    for i in range(1, NUM_DENSE + 1):
+        data[f"_c{i}"] = rng.random_sample(n)
+    for j, vocab in enumerate(CAT_SIZES):
+        data[f"_c{NUM_DENSE + 1 + j}"] = rng.randint(0, vocab, n)
+    return session.createDataFrame(pd.DataFrame(data), num_partitions=4)
+
+
+def test_dlrm_model_shapes():
+    import jax
+    import jax.numpy as jnp
+
+    from raydp_tpu.models import DLRM
+
+    model = DLRM(categorical_sizes=CAT_SIZES, num_dense=NUM_DENSE,
+                 embedding_dim=8, bottom_mlp=(16, 8), top_mlp=(16, 1))
+    batch = {"dense": jnp.ones((32, NUM_DENSE)),
+             "sparse": jnp.zeros((32, len(CAT_SIZES)), jnp.int32)}
+    variables = model.init(jax.random.PRNGKey(0), batch)
+    out = model.apply(variables, batch)
+    assert out.shape == (32, 1)
+    assert variables["params"]["embedding_0"]["embedding"].shape == (40, 8)
+
+
+def test_dlrm_fit_sharded_embeddings(session):
+    import optax
+
+    from raydp_tpu.models import DLRM, criteo_batch_preprocessor, dlrm_param_rules
+    from raydp_tpu.parallel import MeshSpec, make_mesh
+    from raydp_tpu.train import FlaxEstimator
+
+    mesh = make_mesh(MeshSpec(data=2, expert=4))
+    df = _criteo_like(session)
+    features = [f"_c{i}" for i in range(1, NUM_DENSE + 1 + len(CAT_SIZES))]
+
+    est = FlaxEstimator(
+        model=DLRM(categorical_sizes=CAT_SIZES, num_dense=NUM_DENSE,
+                   embedding_dim=8, bottom_mlp=(16, 8), top_mlp=(32, 16, 1)),
+        optimizer=optax.sgd(0.05),
+        loss="bce_with_logits",
+        feature_columns=features,
+        label_column="_c0",
+        feature_dtype=np.float64,
+        batch_size=128,
+        num_epochs=2,
+        mesh=mesh,
+        param_rules=dlrm_param_rules("expert"),
+        batch_preprocessor=criteo_batch_preprocessor(NUM_DENSE),
+        metrics=["accuracy"],
+    )
+    result = est.fit_on_frame(df)
+    assert len(result.history) == 2
+    # embedding tables actually sharded over the expert axis
+    emb = result.state.params["embedding_0"]["embedding"]
+    shard_rows = emb.sharding.shard_shape(emb.shape)[0]
+    assert shard_rows == emb.shape[0] // 4

@@ -1,0 +1,27 @@
+"""The driver's entry contract: single-chip compile + multi-chip dry run."""
+
+import importlib.util
+import os
+
+
+def _load():
+    path = os.path.join(os.path.dirname(__file__), "..", "__graft_entry__.py")
+    spec = importlib.util.spec_from_file_location("__graft_entry__", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_entry_compiles():
+    import jax
+
+    mod = _load()
+    fn, args = mod.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert out.shape[0] == args[-1].shape[0]
+
+
+def test_dryrun_multichip_8():
+    mod = _load()
+    mod.dryrun_multichip(8)

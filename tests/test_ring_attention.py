@@ -1,0 +1,52 @@
+"""Ring attention correctness against dense attention on a seq-sharded mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raydp_tpu.ops.ring_attention import (
+    dense_attention, ring_attention_sharded,
+)
+from raydp_tpu.parallel import MeshSpec, make_mesh
+
+
+def _qkv(b=2, t=64, h=4, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_dense_seq4(causal):
+    mesh = make_mesh(MeshSpec(data=2, seq=4))
+    q, k, v = _qkv()
+    out_ring = ring_attention_sharded(q, k, v, mesh, causal=causal)
+    out_dense = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_dense),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_full_seq8():
+    mesh = make_mesh(MeshSpec(data=1, seq=8))
+    q, k, v = _qkv(b=1, t=128, h=2, d=16, seed=3)
+    out_ring = ring_attention_sharded(q, k, v, mesh, causal=True)
+    out_dense = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_dense),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_grad_flows():
+    mesh = make_mesh(MeshSpec(data=1, seq=8))
+    q, k, v = _qkv(b=1, t=64, h=2, d=8)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, mesh) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v) ** 2)
+
+    g_ring = jax.grad(loss_ring)(q, k, v)
+    g_dense = jax.grad(loss_dense)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense),
+                               atol=5e-4, rtol=5e-4)

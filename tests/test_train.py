@@ -1,0 +1,112 @@
+"""Estimator tests (parity: reference test_torch.py — synthetic linear data,
+object-store vs parquet conversion paths, shape-only model assertions)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from raydp_tpu.etl.expressions import col
+from raydp_tpu.models import MLP
+from raydp_tpu.train import FlaxEstimator
+
+
+def _linear_df(session, n=2048):
+    rng = np.random.RandomState(0)
+    x = rng.random_sample((n, 2)).astype(np.float64)
+    y = x @ np.array([2.0, -3.0]) + 1.0 + rng.normal(0, 0.01, n)
+    pdf = pd.DataFrame({"x1": x[:, 0], "x2": x[:, 1], "y": y})
+    return session.createDataFrame(pdf, num_partitions=4)
+
+
+@pytest.mark.parametrize("use_fs_directory", [False, True])
+def test_estimator_fit_on_frame(session, tmp_path, use_fs_directory):
+    import optax
+
+    df = _linear_df(session)
+    train_df, test_df = df.randomSplit([0.75, 0.25], seed=1)
+    est = FlaxEstimator(
+        model=MLP(features=(16,), use_batch_norm=False),
+        optimizer=optax.adam(1e-2),
+        loss="mse",
+        feature_columns=["x1", "x2"],
+        label_column="y",
+        batch_size=64,
+        num_epochs=3,
+        metrics=["mae", "mse"],
+    )
+    kwargs = {"fs_directory": str(tmp_path / "spill")} if use_fs_directory else {}
+    result = est.fit_on_frame(train_df, test_df, **kwargs)
+    assert len(result.history) == 3
+    last = result.history[-1]
+    assert last["train_loss"] < result.history[0]["train_loss"]
+    assert "eval_mae" in last and "train_mse" in last
+
+    model = est.get_model()
+    kernel = model["params"]["Dense_0"]["kernel"]
+    assert kernel.shape == (2, 16)
+
+
+def test_estimator_batchnorm_model(session):
+    import optax
+
+    from raydp_tpu.models import NYCTaxiModel
+
+    df = _linear_df(session, n=1024)
+    est = FlaxEstimator(
+        model=NYCTaxiModel(),
+        optimizer=optax.adam(1e-3),
+        loss="smooth_l1",
+        feature_columns=["x1", "x2"],
+        label_column="y",
+        batch_size=128,
+        num_epochs=2,
+    )
+    result = est.fit_on_frame(df)
+    assert len(result.history) == 2
+    model = est.get_model()
+    assert "batch_stats" in model
+
+
+def test_estimator_creators_and_retry(session):
+    """Creator callables (parity torch/estimator.py:177-220) + checkpoint resume."""
+    import optax
+
+    df = _linear_df(session, n=512)
+    est = FlaxEstimator(
+        model_creator=lambda: MLP(features=(8,), use_batch_norm=False),
+        optimizer_creator=lambda: optax.sgd(1e-2),
+        loss="mse",
+        feature_columns=["x1", "x2"],
+        label_column="y",
+        batch_size=64,
+        num_epochs=2,
+    )
+    result = est.fit_on_frame(df, max_retries=1)
+    assert len(result.history) == 2
+    assert result.checkpoint_dir is not None
+    import os
+    assert any(d.startswith("step_") for d in os.listdir(result.checkpoint_dir))
+
+
+def test_estimator_sharded_batch(session):
+    """Batch lands sharded over the 8-device data axis; loss still converges."""
+    import jax
+    import optax
+
+    from raydp_tpu.parallel import MeshSpec, make_mesh
+
+    assert len(jax.devices()) == 8
+    mesh = make_mesh(MeshSpec(data=8))
+    df = _linear_df(session, n=2048)
+    est = FlaxEstimator(
+        model=MLP(features=(16,), use_batch_norm=False),
+        optimizer=optax.adam(1e-2),
+        loss="mse",
+        feature_columns=["x1", "x2"],
+        label_column="y",
+        batch_size=256,
+        num_epochs=2,
+        mesh=mesh,
+    )
+    result = est.fit_on_frame(df)
+    assert result.history[-1]["train_loss"] < result.history[0]["train_loss"]
