@@ -106,8 +106,12 @@ class DistributedDataset:
                 raise RuntimeError(f"no executor to serve block {meta.cache_key}")
         return handle
 
-    def get_block(self, i: int) -> pa.Table:
-        return get_client().get(self.get_block_ref(i))
+    def get_block(self, i: int, zero_copy: bool = False) -> pa.Table:
+        """Fetch block ``i``. ``zero_copy=True`` decodes in place over shared
+        memory — valid only while the dataset is not released; the device feed
+        uses it because each batch is consumed (device_put) before the next
+        fetch."""
+        return get_client().get(self.get_block_ref(i), zero_copy=zero_copy)
 
     def blocks(self) -> List[pa.Table]:
         return [self.get_block(i) for i in range(self.num_blocks())]

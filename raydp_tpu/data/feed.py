@@ -93,7 +93,8 @@ class HostBatchIterator:
         buffers: Dict[str, List[np.ndarray]] = {n: [] for n in self.columns}
         buffered = 0
         for block_idx, off, length in parts:
-            table = self.dataset.get_block(block_idx).slice(off, length)
+            table = self.dataset.get_block(block_idx,
+                                           zero_copy=True).slice(off, length)
             if self.shuffle and table.num_rows > 1:
                 perm = rng.permutation(table.num_rows)
                 table = table.take(pa.array(perm))

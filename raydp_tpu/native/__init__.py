@@ -1,0 +1,11 @@
+"""Native (C++) tier of the runtime.
+
+The reference's native tier is its JVM runtime (SURVEY.md §2.2-2.3); the
+component on the data hot path that needs a true native equivalent here is the
+shared-memory object store core (plasma analogue). ``arena`` builds and binds
+``csrc/store/arena.cpp``.
+"""
+
+from raydp_tpu.native.arena import Arena, native_store_available
+
+__all__ = ["Arena", "native_store_available"]

@@ -23,7 +23,9 @@ from raydp_tpu.runtime import object_store as objstore
 from raydp_tpu.runtime.actor import ActorContext, actor_context
 from raydp_tpu.runtime.head import ENV_ACTOR_ID, ENV_HEAD, ENV_SESSION, ENV_SESSION_DIR
 from raydp_tpu.runtime.object_store import ObjectStoreClient
-from raydp_tpu.runtime.rpc import MethodDispatcher, RpcClient, RpcServer
+from raydp_tpu.runtime.rpc import (
+    MethodDispatcher, RpcClient, RpcServer, connect_with_retry,
+)
 
 
 class StoreTableProxy:
@@ -71,7 +73,7 @@ def main() -> None:
     session_dir = os.environ.get(ENV_SESSION_DIR, "/tmp/raydp_tpu")
 
     host, port = head_url.rsplit(":", 1)
-    head = RpcClient((host, int(port)))
+    head = connect_with_retry((host, int(port)))
     spec = head.call("fetch_actor_spec", actor_id)
 
     name = spec["name"]

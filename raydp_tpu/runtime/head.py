@@ -90,6 +90,12 @@ class HeadService:
     def store_owned_by(self, *a):
         return self._rt.store_server.owned_by(*a)
 
+    def store_arena_info(self, *a):
+        return self._rt.store_server.arena_info(*a)
+
+    def store_arena_stats(self, *a):
+        return self._rt.store_server.arena_stats(*a)
+
     # ---- actor lifecycle ----------------------------------------------------
     def fetch_actor_spec(self, actor_id: str) -> Dict[str, Any]:
         rec = self._rt.record(actor_id)
@@ -209,7 +215,8 @@ class RuntimeContext:
         init_logging("driver", self.config.get(cfg.LOG_LEVEL_KEY, "INFO"),
                      os.path.join(self.session_dir, "logs"), self.session_id)
 
-        self.store_server = ObjectStoreServer(self.session_id)
+        self.store_server = ObjectStoreServer(
+            self.session_id, arena=self._create_arena())
         self.resource_manager = ResourceManager()
         if virtual_nodes:
             for res in virtual_nodes:
@@ -234,6 +241,31 @@ class RuntimeContext:
         self._supervisor.start()
         logger.info("runtime head started at %s (session %s)",
                     self.server.url, self.session_id[:12])
+
+    def _create_arena(self):
+        """Native store arena, per ``raydp.tpu.object_store.native``:
+        ``auto`` (default) uses it when the C++ core builds, ``on`` requires
+        it, ``off`` forces per-object segments."""
+        mode = (self.config.get(cfg.NATIVE_OBJECT_STORE_KEY, "auto") or
+                "auto").strip().lower()
+        if mode in ("0", "false", "off", "no"):
+            return None
+        required = mode in ("1", "true", "on", "yes")
+        try:
+            from raydp_tpu.native.arena import Arena
+            size = self.config.get_memory(
+                cfg.OBJECT_STORE_MEMORY_KEY, default=_default_arena_size())
+            arena = Arena.create(f"rdt{self.session_id[:8]}_arena", size)
+            logger.info("native object store arena: %s (%d MiB)",
+                        arena.segment, size >> 20)
+            return arena
+        except Exception as e:
+            if required:
+                raise RuntimeError(
+                    f"native object store requested but unavailable: {e}") from e
+            logger.warning("native store arena unavailable (%s); "
+                           "using per-object segments", e)
+            return None
 
     # ---- actor management ---------------------------------------------------
     def record(self, actor_id: str) -> ActorRecord:
@@ -473,6 +505,18 @@ class RuntimeContext:
         self.server.stop()
         objstore.set_client(None)
         logger.info("runtime head shut down (session %s)", self.session_id[:12])
+
+
+def _default_arena_size() -> int:
+    """Default arena capacity: a quarter of /dev/shm free space, capped at 4 GiB
+    and floored at 64 MiB (objects overflowing the arena fall back to dedicated
+    segments, so undersizing degrades gracefully)."""
+    try:
+        st = os.statvfs("/dev/shm")
+        free = st.f_bavail * st.f_frsize
+    except OSError:
+        free = 1 << 30
+    return max(64 << 20, min(4 << 30, free // 4))
 
 
 def _default_node_resources() -> Dict[str, float]:

@@ -17,8 +17,17 @@ Spark). This module provides the native equivalent:
 - Arrow payloads round-trip as IPC streams so a reader can decode a table without
   copying the body buffers (``pa.ipc.open_stream(pa.py_buffer(view))``).
 
-A C++ slab-allocator core can replace the one-segment-per-object layout behind the
-same client API (see ``csrc/``); segment naming and the table protocol are shared.
+Payload layout has two modes behind the same client API:
+
+- **native arena** (default when the C++ core builds): all payloads live in one
+  session-wide shared-memory segment carved by the C++ slab allocator
+  (``csrc/store/arena.cpp``, bound via :mod:`raydp_tpu.native.arena`). Writers
+  ``rdt_alloc`` from any process; readers attach the one segment once and slice
+  zero-copy views — one mmap per process instead of one per object;
+- **per-object segments** (fallback): each object is its own ``/dev/shm``
+  segment, written once and sealed.
+
+The metadata entry records ``offset >= 0`` for arena-resident payloads.
 """
 
 from __future__ import annotations
@@ -66,6 +75,7 @@ class _Entry:
     size: int
     kind: str
     owner: str
+    offset: int = -1  # >= 0: payload lives at this offset inside the arena
     sealed: bool = True
 
 
@@ -73,28 +83,44 @@ class ObjectStoreServer:
     """Metadata server for the object table. Runs inside the head process.
 
     All methods are called through the head's RPC server; they must stay cheap —
-    object payloads never pass through here, only segment names.
+    object payloads never pass through here, only segment names. When a native
+    arena is present the server also runs its free path (``rdt_free``).
     """
 
-    def __init__(self, session_id: str):
+    def __init__(self, session_id: str, arena=None):
         self.session_id = session_id
+        self._arena = arena
+        # rdt_free/munmap on the arena base must not interleave: a supervisor
+        # or RPC thread freeing a dead owner's blocks races session shutdown.
+        self._arena_lock = threading.Lock()
         self._lock = threading.Lock()
         self._table: Dict[str, _Entry] = {}
 
+    # -- arena ----------------------------------------------------------------
+    def arena_info(self) -> Optional[Dict[str, Any]]:
+        if self._arena is None:
+            return None
+        return {"segment": self._arena.segment, "size": self._arena.size}
+
+    def arena_stats(self) -> Optional[Dict[str, int]]:
+        with self._arena_lock:
+            return None if self._arena is None else self._arena.stats()
+
     # -- write path -----------------------------------------------------------
-    def seal(self, object_id: str, segment: str, size: int, kind: str, owner: str) -> None:
+    def seal(self, object_id: str, segment: str, size: int, kind: str,
+             owner: str, offset: int = -1) -> None:
         with self._lock:
             if object_id in self._table:
                 raise KeyError(f"object {object_id} already sealed")
-            self._table[object_id] = _Entry(segment, size, kind, owner)
+            self._table[object_id] = _Entry(segment, size, kind, owner, offset)
 
     # -- read path ------------------------------------------------------------
-    def lookup(self, object_id: str) -> Tuple[str, int, str]:
+    def lookup(self, object_id: str) -> Tuple[str, int, str, int]:
         with self._lock:
             e = self._table.get(object_id)
             if e is None:
                 raise KeyError(f"object {object_id} not found")
-            return e.segment, e.size, e.kind
+            return e.segment, e.size, e.kind, e.offset
 
     def contains(self, object_id: str) -> bool:
         with self._lock:
@@ -111,10 +137,18 @@ class ObjectStoreServer:
             for oid in object_ids:
                 e = self._table.pop(oid, None)
                 if e is not None:
-                    freed.append(e.segment)
-        for seg in freed:
-            _unlink_segment(seg)
+                    freed.append(e)
+        for e in freed:
+            self._release_payload(e)
         return len(freed)
+
+    def _release_payload(self, e: _Entry) -> None:
+        if e.offset >= 0:
+            with self._arena_lock:
+                if self._arena is not None:
+                    self._arena.free(e.offset)
+        else:
+            _unlink_segment(e.segment)
 
     def transfer_ownership(self, object_ids: List[str], new_owner: str) -> int:
         with self._lock:
@@ -131,9 +165,9 @@ class ObjectStoreServer:
         freed = []
         with self._lock:
             for oid in [o for o, e in self._table.items() if e.owner == owner]:
-                freed.append(self._table.pop(oid).segment)
-        for seg in freed:
-            _unlink_segment(seg)
+                freed.append(self._table.pop(oid))
+        for e in freed:
+            self._release_payload(e)
         return len(freed)
 
     def stats(self) -> Dict[str, Any]:
@@ -150,10 +184,15 @@ class ObjectStoreServer:
 
     def shutdown(self) -> None:
         with self._lock:
-            segments = [e.segment for e in self._table.values()]
+            entries = list(self._table.values())
             self._table.clear()
-        for seg in segments:
-            _unlink_segment(seg)
+        for e in entries:
+            if e.offset < 0:
+                _unlink_segment(e.segment)
+        with self._arena_lock:
+            if self._arena is not None:
+                self._arena.close()
+                self._arena = None
 
 
 def _unlink_segment(segment: str) -> None:
@@ -201,15 +240,50 @@ class ObjectStoreClient:
         self.default_owner = default_owner
         self._attached: Dict[str, shared_memory.SharedMemory] = {}
         self._lock = threading.Lock()
+        self._arena = None          # native write handle, lazily probed
+        self._arena_probed = False
 
     # -- segment naming: session-scoped so shutdown can sweep leftovers -------
     def _segment_name(self, object_id: str) -> str:
         return f"rdt{self.session_id[:8]}_{object_id}"
 
+    def _write_arena(self):
+        """The native arena handle for allocations, or None (fallback mode)."""
+        if self._arena_probed:
+            return self._arena
+        with self._lock:
+            if self._arena_probed:
+                return self._arena
+            try:
+                info = self._server.arena_info()
+                if info is not None:
+                    from raydp_tpu.native.arena import Arena
+                    self._arena = Arena.attach(info["segment"])
+            except Exception as e:
+                logger.warning("arena attach failed (%s); using per-object "
+                               "segments in this process", e)
+                self._arena = None
+            self._arena_probed = True
+        return self._arena
+
     # -- write ----------------------------------------------------------------
     def put_raw(self, data, kind: str = KIND_RAW, owner: Optional[str] = None) -> ObjectRef:
         object_id = new_object_id()
         size = len(data)
+        arena = self._write_arena()
+        if arena is not None:
+            offset = arena.alloc(size)
+            if offset is not None:
+                if size:
+                    view = arena.view(offset, size)
+                    if isinstance(data, memoryview):
+                        view[:] = data.cast("B")
+                    else:
+                        view[:] = data
+                self._server.seal(object_id, arena.segment, size, kind,
+                                  owner or self.default_owner, offset)
+                return ObjectRef(id=object_id, size=size, kind=kind)
+            # arena full: fall through to a dedicated segment
         seg_name = self._segment_name(object_id)
         if size == 0:
             # shm segments cannot be zero-sized; keep 1 byte and record size=0
@@ -239,29 +313,37 @@ class ObjectStoreClient:
 
     # -- read -----------------------------------------------------------------
     def _attach(self, object_id: str) -> Tuple[memoryview, str]:
-        segment, size, kind = self._server.lookup(object_id)
+        segment, size, kind, offset = self._server.lookup(object_id)
         with self._lock:
             shm = self._attached.get(segment)
             if shm is None:
                 shm = shared_memory.SharedMemory(name=segment)
                 _untrack(shm)
                 self._attached[segment] = shm
+        if offset >= 0:
+            return shm.buf[offset:offset + size], kind
         return shm.buf[:size], kind
 
     def get_buffer(self, ref: ObjectRef) -> memoryview:
+        """Borrowed zero-copy view; valid only until the object is freed."""
         view, _ = self._attach(ref.id)
         return view
 
-    def get(self, ref: ObjectRef) -> Any:
+    def get(self, ref: ObjectRef, zero_copy: bool = False) -> Any:
+        """Resolve an object. Arrow payloads copy their IPC stream out of the
+        store by default so the result outlives ``free``; hot paths that
+        consume the table immediately (e.g. the device feed, which copies to
+        HBM anyway) pass ``zero_copy=True`` to decode in place."""
         view, kind = self._attach(ref.id)
         if kind == KIND_ARROW:
-            return pa.ipc.open_stream(pa.py_buffer(view)).read_all()
+            buf = pa.py_buffer(view) if zero_copy else pa.py_buffer(bytes(view))
+            return pa.ipc.open_stream(buf).read_all()
         if kind == KIND_PICKLE:
             return cloudpickle.loads(bytes(view))
         return bytes(view)
 
-    def get_many(self, refs: List[ObjectRef]) -> List[Any]:
-        return [self.get(r) for r in refs]
+    def get_many(self, refs: List[ObjectRef], zero_copy: bool = False) -> List[Any]:
+        return [self.get(r, zero_copy=zero_copy) for r in refs]
 
     # -- lifetime -------------------------------------------------------------
     def free(self, refs: List[ObjectRef]) -> int:
@@ -297,6 +379,11 @@ class ObjectStoreClient:
                 except Exception:
                     pass
             self._attached.clear()
+            # the write-arena mapping is deliberately NOT munmapped here: an
+            # in-flight put_raw may still be writing through a view, and the
+            # OS reclaims the mapping at process exit anyway
+            self._arena = None
+            self._arena_probed = False
 
 
 # -- process-global client (set by head init / actor bootstrap) ---------------------

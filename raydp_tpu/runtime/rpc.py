@@ -15,6 +15,7 @@ with RayExecutorUtils.java:60 ``setMaxConcurrency(2)``).
 
 from __future__ import annotations
 
+import logging
 import socket
 import struct
 import threading
@@ -23,6 +24,8 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import cloudpickle
+
+logger = logging.getLogger("raydp_tpu.rpc")
 
 _LEN = struct.Struct(">Q")
 _MAX_FRAME = 1 << 40
@@ -97,7 +100,6 @@ class RpcServer:
         self._stopped = threading.Event()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name=f"{name}-accept", daemon=True)
-        self._conn_threads: list = []
         self._accept_thread.start()
 
     @property
@@ -108,12 +110,20 @@ class RpcServer:
         while not self._stopped.is_set():
             try:
                 conn, _ = self._sock.accept()
-            except OSError:
+            except OSError as e:
+                if not self._stopped.is_set():
+                    logger.error("rpc server accept loop died: %r", e)
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
-            t.start()
-            self._conn_threads.append(t)
+            try:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True).start()
+            except Exception as e:  # e.g. thread-limit; keep accepting
+                logger.error("rpc server failed to serve connection: %r", e)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
     def _serve_conn(self, conn: socket.socket) -> None:
         send_lock = threading.Lock()
@@ -124,6 +134,9 @@ class RpcServer:
                 self._pool.submit(self._dispatch, conn, send_lock, req_id, method, args, kwargs)
         except (ConnectionLost, OSError):
             pass
+        except BaseException as e:  # noqa: BLE001 - diagnose, drop only this conn
+            logger.error("rpc connection handler died: %r\n%s", e,
+                         traceback.format_exc())
         finally:
             try:
                 conn.close()
@@ -222,6 +235,32 @@ class RpcClient:
             self._sock.close()
         except OSError:
             pass
+
+
+def connect_with_retry(address: Tuple[str, int], attempts: int = 6,
+                       delay: float = 0.25) -> "RpcClient":
+    """Connect and verify liveness with ``ping``, retrying transient failures.
+
+    Bootstrap connections (actor → head, SPMD rank → driver) occasionally see
+    ECONNRESET when ephemeral ports recycle across rapid session cycles; a
+    fresh socket resolves it. Used only at process startup where every call is
+    idempotent.
+    """
+    import time as _time
+
+    last: Optional[Exception] = None
+    for attempt in range(attempts):
+        client = None
+        try:
+            client = RpcClient(address)
+            client.call("ping", timeout=10.0)
+            return client
+        except Exception as e:  # noqa: BLE001 - retry any transient failure
+            last = e
+            if client is not None:
+                client.close()
+            _time.sleep(delay * (attempt + 1))
+    raise ConnectionLost(f"could not reach {address} after {attempts} attempts: {last}")
 
 
 class MethodDispatcher:

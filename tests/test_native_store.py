@@ -1,0 +1,190 @@
+"""Native (C++) object-store core: allocator invariants + store integration.
+
+Parity model: the reference rides Ray's plasma store (native shared memory,
+SURVEY.md §2.3 item 11); these tests cover our C++ arena the way the reference's
+suite covers its data plane — real processes, real shared memory, fault paths
+(test_spark_cluster.py:262-366 exercises cached-block recovery and GC).
+"""
+
+import multiprocessing as mp
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from raydp_tpu.native.arena import Arena, native_store_available
+
+pytestmark = pytest.mark.skipif(
+    not native_store_available(), reason="native store core did not build")
+
+
+@pytest.fixture
+def arena():
+    a = Arena.create(f"rdt-test-{mp.current_process().pid}", 8 << 20)
+    yield a
+    a.close()
+
+
+def test_alloc_free_roundtrip(arena):
+    off = arena.alloc(1000)
+    assert off is not None and off % 64 == 0
+    view = arena.view(off, 1000)
+    view[:] = b"x" * 1000
+    assert bytes(arena.view(off, 1000)) == b"x" * 1000
+    stats = arena.stats()
+    assert stats["num_allocs"] == 1
+    assert stats["bytes_in_use"] >= 1000
+    assert arena.free(off)
+    stats = arena.stats()
+    assert stats["num_allocs"] == 0
+    assert stats["bytes_in_use"] == 0
+
+
+def test_double_free_rejected(arena):
+    off = arena.alloc(64)
+    assert arena.free(off)
+    assert not arena.free(off)
+
+
+def test_bogus_free_rejected(arena):
+    assert not arena.free(12345 + 3)  # unaligned garbage offset
+    assert not arena.free(arena.size + 64)  # out of range
+
+
+def test_split_and_coalesce(arena):
+    # Allocate three adjacent blocks, free in an order that exercises both
+    # predecessor and successor coalescing, then verify the space is reusable
+    # as one large block.
+    offs = [arena.alloc(4096) for _ in range(3)]
+    assert all(o is not None for o in offs)
+    baseline = arena.stats()["bytes_in_use"]
+    assert baseline >= 3 * 4096
+    arena.free(offs[1])
+    arena.free(offs[0])  # coalesces with freed middle block
+    arena.free(offs[2])  # coalesces with the merged front block
+    assert arena.stats()["bytes_in_use"] == 0
+    big = arena.alloc(3 * 4096 + 128)
+    assert big is not None
+    assert big == offs[0]  # space was merged back into one front block
+
+
+def test_exhaustion_returns_none(arena):
+    assert arena.alloc(64 << 20) is None  # larger than the 8 MiB arena
+    offs = []
+    while True:
+        off = arena.alloc(1 << 20)
+        if off is None:
+            break
+        offs.append(off)
+    assert len(offs) >= 6  # 8 MiB arena, 1 MiB blocks, minus headers
+    for off in offs:
+        assert arena.free(off)
+    assert arena.stats()["bytes_in_use"] == 0
+
+
+def test_concurrent_alloc_free_threads(arena):
+    errors = []
+
+    def worker(seed):
+        rng = np.random.RandomState(seed)
+        try:
+            for _ in range(200):
+                size = int(rng.randint(1, 8192))
+                off = arena.alloc(size)
+                if off is None:
+                    continue
+                view = arena.view(off, size)
+                view[:] = bytes([seed % 256]) * size
+                if bytes(view) != bytes([seed % 256]) * size:
+                    errors.append("corrupt payload")
+                if not arena.free(off):
+                    errors.append("free failed")
+        except Exception as e:  # pragma: no cover
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert arena.stats()["num_allocs"] == 0
+
+
+def _child_alloc(segment, out_q):
+    a = Arena.attach(segment)
+    off = a.alloc(512)
+    a.view(off, 512)[:] = b"c" * 512
+    out_q.put(off)
+    a.detach()
+
+
+def test_cross_process_alloc(arena):
+    """A second process allocates from the same arena; the parent reads the
+    payload zero-copy — the plasma-style multi-writer contract."""
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_alloc, args=(arena.segment, q))
+    p.start()
+    off = q.get(timeout=30)
+    p.join(timeout=30)
+    assert p.exitcode == 0
+    assert bytes(arena.view(off, 512)) == b"c" * 512
+    assert arena.stats()["num_allocs"] == 1
+    assert arena.free(off)
+
+
+def test_store_uses_arena(runtime):
+    """Default (auto) mode: payloads land in the arena, free reclaims them,
+    Arrow tables round-trip zero-copy."""
+    client = runtime.store_client
+    info = runtime.store_server.arena_info()
+    assert info is not None, "native core built but arena not created"
+
+    table = pa.table({"a": np.arange(1000), "b": np.random.rand(1000)})
+    ref = client.put(table)
+    seg, size, kind, offset = runtime.store_server.lookup(ref.id)
+    assert offset >= 0 and seg == info["segment"]
+    got = client.get(ref)
+    assert got.equals(table)
+
+    before = runtime.store_server.arena_stats()["bytes_in_use"]
+    assert before > 0
+    client.free([ref])
+    after = runtime.store_server.arena_stats()["bytes_in_use"]
+    assert after < before
+
+
+def test_store_survives_actor_writes(runtime):
+    """An actor process writes through the arena; the driver reads it back."""
+    class Writer:
+        def put_table(self, n):
+            from raydp_tpu.runtime.object_store import get_client
+            t = pa.table({"x": np.arange(n, dtype=np.int64)})
+            return get_client().put(t)
+
+    handle = runtime.create_actor(Writer, name="arena-writer")
+    ref = handle.call("put_table", 4096)
+    seg, size, kind, offset = runtime.store_server.lookup(ref.id)
+    assert offset >= 0, "actor write did not use the arena"
+    table = runtime.store_client.get(ref)
+    assert table.num_rows == 4096
+    assert table["x"][4095].as_py() == 4095
+
+
+def test_store_native_off(monkeypatch):
+    """Forced-off mode still round-trips through per-object segments."""
+    from raydp_tpu import config as cfg
+    from raydp_tpu.runtime import head as head_mod
+
+    rt = head_mod.RuntimeContext(
+        config=cfg.Config({cfg.NATIVE_OBJECT_STORE_KEY: "off"}))
+    try:
+        assert rt.store_server.arena_info() is None
+        ref = rt.store_client.put({"k": 1})
+        assert rt.store_client.get(ref) == {"k": 1}
+        seg, size, kind, offset = rt.store_server.lookup(ref.id)
+        assert offset == -1
+    finally:
+        rt.shutdown()
