@@ -1,0 +1,19 @@
+"""Gang-scheduled SPMD job subsystem — the reference's MPI pillar, TPU-native.
+
+The reference runs arbitrary MPI programs on its cluster: a gRPC control plane
+broadcasts cloudpickled functions to mpirun-launched ranks and gathers results
+(reference: python/raydp/mpi/__init__.py:36-91, mpi_job.py:165-338,
+mpi_worker.py:144-214). Here the external process gang is a JAX process group:
+one process per host (per chip-set), meshed by ``jax.distributed.initialize``
+— the coordinator service replaces mpirun's wire-up, and in-program collectives
+are XLA collectives over ICI/DCN instead of MPI.
+
+    job = create_spmd_job("train", world_size=4)
+    job.start()
+    results = job.run(lambda ctx: ctx.rank * 2)
+    job.stop()
+"""
+
+from raydp_tpu.spmd.job import SPMDJob, WorkerContext, create_spmd_job
+
+__all__ = ["create_spmd_job", "SPMDJob", "WorkerContext"]

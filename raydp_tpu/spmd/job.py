@@ -1,0 +1,319 @@
+"""Driver side of the gang-SPMD job runner.
+
+Parity map (reference → here):
+
+- ``MPIJob.start`` — gRPC DriverService + STRICT_SPREAD placement group +
+  ``mpirun`` spawn + two-phase registration barrier
+  (mpi_job.py:165-318) → an RPC driver service, a placement group over the
+  runtime's nodes, a direct gang spawn of rank processes, and the same
+  two-phase barrier (register → start worker service → register service).
+- ``MPIJob.run(fn)`` — cloudpickle broadcast + world-size result gather
+  (mpi_job.py:324-338) → synchronous fan-out over per-rank RPC stubs with
+  in-order ``func_id`` sequencing enforced worker-side (mpi_worker.py:75-96).
+- ``OpenMPIJob``/``IntelMPIJob``/``MPICHJob`` mpirun-flag variants
+  (mpi_job.py:411-429) → ``jax_distributed=True`` wires a JAX coordinator
+  (rank 0) so ranks form one global device mesh; ``False`` runs plain Python
+  ranks (still gang-placed, still object-store-connected).
+- each MPI rank also joins Ray (mpi_worker.py:159-160) → each rank inherits
+  the head address + session env and connects an object-store client, so SPMD
+  programs can read/write the Arrow data plane.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+from raydp_tpu.log import get_logger
+from raydp_tpu.runtime.rpc import MethodDispatcher, RpcClient, RpcServer
+
+logger = get_logger("spmd")
+
+ENV_JOB_ID = "RDT_SPMD_JOB_ID"
+ENV_DRIVER = "RDT_SPMD_DRIVER"
+ENV_RANK = "RDT_SPMD_RANK"
+ENV_WORLD = "RDT_SPMD_WORLD_SIZE"
+ENV_COORDINATOR = "RDT_SPMD_COORDINATOR"
+ENV_JAX_DIST = "RDT_SPMD_JAX_DISTRIBUTED"
+
+
+@dataclass
+class WorkerContext:
+    """Handed to the user function on every rank (parity: mpi_worker.py
+    ``WorkerContext`` — job name, rank, world size)."""
+
+    job_id: str
+    rank: int
+    world_size: int
+
+    def __repr__(self):
+        return f"WorkerContext(job={self.job_id}, rank={self.rank}/{self.world_size})"
+
+
+class _DriverService:
+    """Registration + liveness endpoint the ranks call into
+    (parity: DriverService in mpi/network/network.proto:22-30)."""
+
+    def __init__(self, job: "SPMDJob"):
+        self._job = job
+
+    def register_worker(self, rank: int, pid: int) -> Dict[str, Any]:
+        return self._job._on_register_worker(rank, pid)
+
+    def register_worker_service(self, rank: int, host: str, port: int) -> bool:
+        return self._job._on_register_service(rank, host, port)
+
+    def ping(self) -> str:
+        return "pong"
+
+
+class SPMDJob:
+    """A restartable gang of SPMD rank processes under one control plane.
+
+    ``start()`` → ``run(fn)``×N → ``stop()``; the same object can be started
+    again after ``stop()`` (the reference's test restarts a job object,
+    test_mpi.py start/run/stop/restart case).
+    """
+
+    def __init__(
+        self,
+        job_name: str,
+        world_size: int,
+        env: Optional[Dict[str, str]] = None,
+        jax_distributed: bool = False,
+        placement_strategy: str = "SPREAD",
+        cpus_per_process: float = 1.0,
+        timeout: float = 120.0,
+    ):
+        self.job_name = job_name
+        self.world_size = world_size
+        self.extra_env = dict(env or {})
+        self.jax_distributed = jax_distributed
+        self.placement_strategy = placement_strategy
+        self.cpus_per_process = cpus_per_process
+        self.timeout = timeout
+
+        self._server: Optional[RpcServer] = None
+        self._procs: List[subprocess.Popen] = []
+        self._stubs: Dict[int, RpcClient] = {}
+        self._registered: Dict[int, int] = {}
+        self._services: Dict[int, tuple] = {}
+        self._barrier = threading.Condition()
+        self._func_id = 0
+        self._started = False
+        self._placement_group_id: Optional[str] = None
+
+    # -- registration callbacks (driver service) ------------------------------
+    def _on_register_worker(self, rank: int, pid: int) -> Dict[str, Any]:
+        with self._barrier:
+            self._registered[rank] = pid
+            self._barrier.notify_all()
+        return {"job_id": self.job_name, "world_size": self.world_size}
+
+    def _on_register_service(self, rank: int, host: str, port: int) -> bool:
+        with self._barrier:
+            self._services[rank] = (host, port)
+            self._barrier.notify_all()
+        return True
+
+    def _wait_barrier(self, table: dict, phase: str) -> None:
+        deadline = time.time() + self.timeout
+        with self._barrier:
+            while len(table) < self.world_size:
+                remaining = deadline - time.time()
+                if remaining <= 0 or not self._barrier.wait(timeout=min(1.0, remaining)):
+                    self._check_procs_alive()
+                if time.time() >= deadline and len(table) < self.world_size:
+                    raise TimeoutError(
+                        f"SPMD job {self.job_name}: {phase} barrier timed out "
+                        f"({len(table)}/{self.world_size} ranks)")
+
+    def _check_procs_alive(self) -> None:
+        for i, p in enumerate(self._procs):
+            code = p.poll()
+            if code is not None and code != 0:
+                raise RuntimeError(
+                    f"SPMD job {self.job_name}: rank {i} exited with code "
+                    f"{code} during startup (see {self._log_path(i)})")
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "SPMDJob":
+        if self._started:
+            raise RuntimeError(f"SPMD job {self.job_name} already started")
+        self._reserve_placement()
+        self._server = RpcServer(MethodDispatcher(_DriverService(self)),
+                                 max_concurrency=max(4, self.world_size),
+                                 name=f"spmd-{self.job_name}")
+        coordinator = f"127.0.0.1:{_free_port()}" if self.jax_distributed else ""
+        for rank in range(self.world_size):
+            self._procs.append(self._spawn_rank(rank, coordinator))
+        # two-phase barrier (parity: mpi_job.py:280-318)
+        self._wait_barrier(self._registered, "register")
+        self._wait_barrier(self._services, "service")
+        for rank, addr in sorted(self._services.items()):
+            self._stubs[rank] = RpcClient(addr)
+        self._started = True
+        logger.info("SPMD job %s started: %d ranks%s", self.job_name,
+                    self.world_size,
+                    " (jax.distributed mesh)" if self.jax_distributed else "")
+        return self
+
+    def _reserve_placement(self) -> None:
+        """Gang-reserve CPU bundles through the runtime when one is live
+        (parity: STRICT_SPREAD pg pinning nodes, mpi_job.py:192-222); a bare
+        job without a runtime still works — it is just unaccounted."""
+        from raydp_tpu.runtime import head as head_mod
+
+        if not head_mod.runtime_initialized():
+            return
+        rt = head_mod.get_runtime()
+        bundles = [{"CPU": self.cpus_per_process}
+                   for _ in range(self.world_size)]
+        from raydp_tpu.runtime.placement import PlacementStrategy
+        group = rt.resource_manager.create_group(
+            bundles, PlacementStrategy(self.placement_strategy.upper()))
+        self._placement_group_id = group.group_id
+
+    def _log_path(self, rank: int) -> str:
+        from raydp_tpu.runtime import head as head_mod
+
+        if head_mod.runtime_initialized():
+            base = os.path.join(head_mod.get_runtime().session_dir, "logs")
+        else:
+            base = "/tmp/raydp_tpu/spmd"
+        os.makedirs(base, exist_ok=True)
+        return os.path.join(base, f"spmd-{self.job_name}-rank{rank}.out")
+
+    def _spawn_rank(self, rank: int, coordinator: str) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        from raydp_tpu.runtime import head as head_mod
+        if head_mod.runtime_initialized():
+            # hand ranks the session so they join the data plane
+            # (parity: ray.init in every MPI rank, mpi_worker.py:159-160)
+            rt = head_mod.get_runtime()
+            env[head_mod.ENV_HEAD] = rt.server.url
+            env[head_mod.ENV_SESSION] = rt.session_id
+            env[head_mod.ENV_SESSION_DIR] = rt.session_dir
+        env[ENV_JOB_ID] = self.job_name
+        env[ENV_DRIVER] = self._server.url
+        env[ENV_RANK] = str(rank)
+        env[ENV_WORLD] = str(self.world_size)
+        env[ENV_JAX_DIST] = "1" if self.jax_distributed else "0"
+        if coordinator:
+            env[ENV_COORDINATOR] = coordinator
+        driver_path = [p for p in sys.path if p]
+        if env.get("PYTHONPATH"):
+            driver_path.append(env["PYTHONPATH"])
+        env["PYTHONPATH"] = os.pathsep.join(driver_path)
+        out = open(self._log_path(rank), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-m", "raydp_tpu.spmd.worker"],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        out.close()
+        return proc
+
+    # -- execution ------------------------------------------------------------
+    def run(self, fn: Callable[[WorkerContext], Any],
+            timeout: Optional[float] = None) -> List[Any]:
+        """Broadcast ``fn`` to every rank; return world-size results ordered by
+        rank (parity: mpi_job.py:324-338)."""
+        if not self._started:
+            raise RuntimeError(f"SPMD job {self.job_name} not started")
+        self._func_id += 1
+        payload = cloudpickle.dumps(fn)
+        futures = {rank: stub.submit("run_function", self._func_id, payload)
+                   for rank, stub in self._stubs.items()}
+        results: List[Any] = [None] * self.world_size
+        for rank, fut in futures.items():
+            ok, value = fut.result(timeout=timeout or self.timeout)
+            if not ok:
+                raise RuntimeError(
+                    f"SPMD job {self.job_name} rank {rank} failed:\n{value}")
+            results[rank] = value
+        return results
+
+    def rank_addresses(self) -> Dict[int, tuple]:
+        """Rank → worker-service address (parity: the reference exposes
+        worker addresses for tests, test_mpi.py rank-address query)."""
+        return dict(self._services)
+
+    def stop(self) -> None:
+        for rank, stub in list(self._stubs.items()):
+            try:
+                stub.submit("stop")
+            except Exception:
+                pass
+        deadline = time.time() + 5.0
+        for p in self._procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    try:
+                        p.kill()
+                    except ProcessLookupError:
+                        pass
+        self._reset()
+
+    def _reset(self) -> None:
+        """Full teardown so the same job object can start again
+        (parity: mpi_job.py:344-395 ``_reset``)."""
+        for stub in self._stubs.values():
+            stub.close()
+        self._stubs.clear()
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+        if self._placement_group_id is not None:
+            from raydp_tpu.runtime import head as head_mod
+            if head_mod.runtime_initialized():
+                try:
+                    head_mod.get_runtime().resource_manager.remove_group(
+                        self._placement_group_id)
+                except Exception:
+                    pass
+            self._placement_group_id = None
+        self._procs.clear()
+        self._registered.clear()
+        self._services.clear()
+        self._func_id = 0
+        self._started = False
+        logger.info("SPMD job %s stopped", self.job_name)
+
+
+def create_spmd_job(
+    job_name: str,
+    world_size: int,
+    env: Optional[Dict[str, str]] = None,
+    jax_distributed: bool = False,
+    placement_strategy: str = "SPREAD",
+    cpus_per_process: float = 1.0,
+    timeout: float = 120.0,
+) -> SPMDJob:
+    """Factory, shape-parity with ``raydp.mpi.create_mpi_job``
+    (mpi/__init__.py:36-91)."""
+    return SPMDJob(job_name=job_name, world_size=world_size, env=env,
+                   jax_distributed=jax_distributed,
+                   placement_strategy=placement_strategy,
+                   cpus_per_process=cpus_per_process, timeout=timeout)
+
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

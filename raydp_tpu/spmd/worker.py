@@ -1,0 +1,141 @@
+"""Rank-process entry point (``python -m raydp_tpu.spmd.worker``).
+
+Parity: ``mpi_worker.py`` — rank from env (33-42), two-phase registration to the
+driver (144-166), in-order function execution with ``func_id`` sequencing
+(63-96), and joining the data plane the way each MPI rank re-joins Ray
+(159-160): if this process inherited a runtime head address it connects an
+object-store client before serving functions.
+
+When ``RDT_SPMD_JAX_DISTRIBUTED=1`` the rank calls
+``jax.distributed.initialize`` against the job coordinator before serving, so
+user functions run inside one global JAX process group — collectives are XLA
+collectives over the global device mesh, the TPU-native replacement for the
+reference's in-rank MPI calls.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+
+import cloudpickle
+
+from raydp_tpu.log import init_logging
+from raydp_tpu.runtime.rpc import RpcServer, connect_with_retry
+from raydp_tpu.spmd.job import (
+    ENV_COORDINATOR, ENV_DRIVER, ENV_JAX_DIST, ENV_JOB_ID, ENV_RANK, ENV_WORLD,
+    WorkerContext,
+)
+
+
+class _WorkerService:
+    """Serves RunFunction/Stop (parity: WorkerService, network.proto:32-37)."""
+
+    def __init__(self, ctx: WorkerContext):
+        self._ctx = ctx
+        self._last_func_id = 0
+        self._lock = threading.Lock()
+
+    def __call__(self, method: str, args: tuple, kwargs: dict):
+        if method == "run_function":
+            return self._run_function(*args)
+        if method == "stop":
+            threading.Thread(target=_delayed_exit, daemon=True).start()
+            return True
+        if method == "ping":
+            return "pong"
+        raise AttributeError(f"unknown worker method {method!r}")
+
+    def _run_function(self, func_id: int, payload: bytes):
+        with self._lock:  # functions run one at a time, in order
+            if func_id != self._last_func_id + 1:
+                return False, (f"out-of-order function: got {func_id}, "
+                               f"expected {self._last_func_id + 1}")
+            fn = cloudpickle.loads(payload)
+            try:
+                value = fn(self._ctx)
+                self._last_func_id = func_id
+                return True, value
+            except BaseException:  # noqa: BLE001 - report any failure to driver
+                self._last_func_id = func_id
+                return False, traceback.format_exc()
+
+
+def _delayed_exit():
+    time.sleep(0.2)
+    os._exit(0)
+
+
+def main() -> None:
+    job_id = os.environ[ENV_JOB_ID]
+    driver_url = os.environ[ENV_DRIVER]
+    rank = int(os.environ[ENV_RANK])
+    world_size = int(os.environ[ENV_WORLD])
+
+    init_logging(f"spmd-{job_id}-r{rank}", os.environ.get("RDT_LOG_LEVEL", "INFO"),
+                 None, job_id)
+
+    if os.environ.get(ENV_JAX_DIST) == "1":
+        import jax
+        # interpreter startup may have pre-registered a hardware platform;
+        # backend init is lazy, so re-assert the requested platform before
+        # the first device touch (same dance as tests/conftest.py)
+        if os.environ.get("JAX_PLATFORMS"):
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        jax.distributed.initialize(
+            coordinator_address=os.environ[ENV_COORDINATOR],
+            num_processes=world_size, process_id=rank)
+
+    # join the data plane if a runtime session is live (parity: ray.init in
+    # every MPI rank, mpi_worker.py:159-160)
+    from raydp_tpu.runtime import head as head_mod
+    from raydp_tpu.runtime import object_store as objstore
+    from raydp_tpu.runtime.actor_main import StoreTableProxy
+
+    head_url = os.environ.get(head_mod.ENV_HEAD)
+    session_id = os.environ.get(head_mod.ENV_SESSION)
+    if head_url and session_id:
+        host, port = head_url.rsplit(":", 1)
+        try:
+            head_client = connect_with_retry((host, int(port)))
+            store = objstore.ObjectStoreClient(
+                StoreTableProxy(head_client), session_id,
+                default_owner=f"spmd-{job_id}-r{rank}")
+            objstore.set_client(store)
+        except Exception as e:
+            import logging
+            logging.getLogger("raydp_tpu").warning(
+                "rank %d could not join the object store at %s: %s "
+                "(functions needing the data plane will fail)",
+                rank, head_url, e)
+
+    ctx = WorkerContext(job_id=job_id, rank=rank, world_size=world_size)
+
+    d_host, d_port = driver_url.rsplit(":", 1)
+    driver = connect_with_retry((d_host, int(d_port)))
+    reply = driver.call("register_worker", rank, os.getpid())
+    assert reply["world_size"] == world_size
+
+    server = RpcServer(_WorkerService(ctx), host="127.0.0.1", port=0,
+                       max_concurrency=2, name=f"spmd-r{rank}")
+    driver.call("register_worker_service", rank, server.address[0],
+                server.address[1])
+
+    # die with the driver (parity: mpirun teardown kills ranks; here the rank
+    # watches the control connection)
+    try:
+        while True:
+            driver.call("ping", timeout=30.0)
+            time.sleep(5.0)
+    except Exception:
+        pass
+    finally:
+        server.stop()
+        os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+"""Gang-SPMD job runner tests (parity model: reference test_mpi.py — start/run/
+stop + restart of the same job object, rank addressing, env propagation,
+placement-group variant; SURVEY.md §4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from raydp_tpu.spmd import create_spmd_job
+
+
+def test_start_run_stop_restart():
+    job = create_spmd_job("t-basic", world_size=3, timeout=60)
+    job.start()
+    try:
+        results = job.run(lambda ctx: ctx.rank * 10)
+        assert results == [0, 10, 20]
+        # in-order sequencing: a second broadcast works
+        results = job.run(lambda ctx: ctx.world_size)
+        assert results == [3, 3, 3]
+    finally:
+        job.stop()
+    # the same object restarts cleanly (parity: test_mpi.py restart case)
+    job.start()
+    try:
+        assert job.run(lambda ctx: ctx.job_id) == ["t-basic"] * 3
+    finally:
+        job.stop()
+
+
+def test_env_propagation():
+    job = create_spmd_job("t-env", world_size=2,
+                          env={"RDT_TEST_MARKER": "hello"}, timeout=60)
+    job.start()
+    try:
+        got = job.run(lambda ctx: os.environ.get("RDT_TEST_MARKER"))
+        assert got == ["hello", "hello"]
+    finally:
+        job.stop()
+
+
+def test_rank_addresses():
+    job = create_spmd_job("t-addr", world_size=2, timeout=60)
+    job.start()
+    try:
+        addrs = job.rank_addresses()
+        assert set(addrs) == {0, 1}
+        assert all(len(a) == 2 for a in addrs.values())
+    finally:
+        job.stop()
+
+
+def test_failure_surfaces_rank_and_traceback():
+    job = create_spmd_job("t-fail", world_size=2, timeout=60)
+    job.start()
+    try:
+        def boom(ctx):
+            if ctx.rank == 1:
+                raise ValueError("rank 1 exploded")
+            return "ok"
+
+        with pytest.raises(RuntimeError, match="rank 1"):
+            job.run(boom)
+        # the gang survives a function failure and keeps sequencing
+        assert job.run(lambda ctx: ctx.rank) == [0, 1]
+    finally:
+        job.stop()
+
+
+def test_placement_group_accounting(runtime):
+    job = create_spmd_job("t-pg", world_size=2, cpus_per_process=1.0, timeout=60)
+    job.start()
+    try:
+        assert job._placement_group_id is not None
+        assert runtime.resource_manager.get_group(job._placement_group_id) is not None
+    finally:
+        job.stop()
+    # pg removed on stop (parity: pg-leak check, test_spark_cluster.py:219-259)
+    assert runtime.resource_manager.get_group("t-pg") is None
+
+
+def test_ranks_share_object_store(runtime):
+    """Ranks inherit the head env and can exchange data through the store —
+    parity with every MPI rank joining Ray (mpi_worker.py:159-160)."""
+    import pyarrow as pa
+
+    table = pa.table({"x": np.arange(64, dtype=np.int64)})
+    ref = runtime.store_client.put(table)
+
+    job = create_spmd_job("t-store", world_size=2, timeout=60)
+    job.start()
+    try:
+        def read_sum(ctx, ref=ref):
+            from raydp_tpu.runtime.object_store import get_client
+            t = get_client().get(ref)
+            return int(np.asarray(t["x"]).sum())
+
+        assert job.run(read_sum) == [2016, 2016]
+    finally:
+        job.stop()
+
+
+def test_jax_distributed_gang():
+    """world=2 ranks form one jax.distributed mesh; a psum across the global
+    device set returns the world sum on every rank — the XLA-collective
+    replacement for the reference's in-rank MPI allreduce."""
+    job = create_spmd_job(
+        "t-jaxdist", world_size=2, jax_distributed=True, timeout=180,
+        env={"XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+             "JAX_PLATFORMS": "cpu"})
+    job.start()
+    try:
+        def allreduce(ctx):
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            devices = np.array(jax.devices())
+            assert devices.size == ctx.world_size
+            mesh = Mesh(devices, ("dp",))
+
+            def f(x):
+                return jax.lax.psum(x, "dp")
+
+            shard = jnp.array([float(ctx.rank + 1)])
+            out = jax.jit(shard_map(
+                f, mesh=mesh, in_specs=P("dp"), out_specs=P()))(
+                    jax.make_array_from_process_local_data(
+                        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("dp")),
+                        shard, (ctx.world_size,)))
+            return float(np.asarray(out)[0])
+
+        assert job.run(allreduce, timeout=180) == [3.0, 3.0]
+    finally:
+        job.stop()
