@@ -13,11 +13,24 @@ from raydp_tpu.train.estimator import EstimatorInterface, FrameEstimatorInterfac
 from raydp_tpu.train.flax_estimator import FlaxEstimator, TrainingResult
 from raydp_tpu.train.metrics import Metric, build_metrics
 
+from raydp_tpu.train.gbdt_estimator import GBDTEstimator
+
 __all__ = [
     "EstimatorInterface",
     "FrameEstimatorInterface",
     "FlaxEstimator",
+    "GBDTEstimator",
+    "KerasEstimator",
     "TrainingResult",
     "Metric",
     "build_metrics",
 ]
+
+
+def __getattr__(name):
+    # keras imports TF-adjacent machinery at module load; keep it lazy so the
+    # core train tier stays import-light
+    if name == "KerasEstimator":
+        from raydp_tpu.train.keras_estimator import KerasEstimator
+        return KerasEstimator
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

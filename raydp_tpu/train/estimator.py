@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import os
 from abc import ABC, abstractmethod
 from typing import Optional
 
@@ -30,3 +31,34 @@ class FrameEstimatorInterface(ABC):
                      stop_etl_after_conversion: bool = False,
                      max_retries: int = 0):
         ...
+
+    @staticmethod
+    def _convert_frames(train_df, evaluate_df=None, *,
+                        fs_directory: Optional[str] = None,
+                        stop_etl_after_conversion: bool = False):
+        """Frames → datasets through the chosen conversion path; optionally
+        stop the ETL engine with ownership transferred to the master so the
+        data survives (parity: torch/estimator.py:358-390, dataset.py:137-158).
+        Shared by every concrete estimator's ``fit_on_frame``."""
+        import raydp_tpu
+        from raydp_tpu.data import from_frame, from_frame_recoverable
+
+        def convert(df, tag):
+            if df is None:
+                return None
+            if fs_directory is not None:
+                # parquet spill path (parity: torch/estimator.py:365-376)
+                path = os.path.join(fs_directory, tag)
+                df.write.parquet(path)
+                session = df._session
+                return from_frame(session.read.parquet(path))
+            return from_frame_recoverable(df)
+
+        train_ds = convert(train_df, "train")
+        eval_ds = convert(evaluate_df, "eval")
+        if stop_etl_after_conversion:
+            train_ds.transfer_to_master()
+            if eval_ds is not None:
+                eval_ds.transfer_to_master()
+            raydp_tpu.stop(cleanup_data=False)
+        return train_ds, eval_ds

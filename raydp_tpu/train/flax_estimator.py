@@ -365,30 +365,9 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                      fs_directory: Optional[str] = None,
                      stop_etl_after_conversion: bool = False,
                      max_retries: int = 0) -> TrainingResult:
-        import raydp_tpu
-        from raydp_tpu.data import from_frame, from_frame_recoverable
-
-        def convert(df, tag):
-            if df is None:
-                return None
-            if fs_directory is not None:
-                # parquet spill path (parity: torch/estimator.py:365-376)
-                path = os.path.join(fs_directory, tag)
-                df.write.parquet(path)
-                session = df._session
-                return from_frame(session.read.parquet(path))
-            return from_frame_recoverable(df)
-
-        train_ds = convert(train_df, "train")
-        eval_ds = convert(evaluate_df, "eval")
-
-        if stop_etl_after_conversion:
-            # parity: stop_spark_after_conversion + ownership transfer
-            # (torch/estimator.py:387-388, dataset.py:137-158)
-            train_ds.transfer_to_master()
-            if eval_ds is not None:
-                eval_ds.transfer_to_master()
-            raydp_tpu.stop(cleanup_data=False)
+        train_ds, eval_ds = self._convert_frames(
+            train_df, evaluate_df, fs_directory=fs_directory,
+            stop_etl_after_conversion=stop_etl_after_conversion)
 
         if self.shuffle:
             # parity: random_shuffle before training (torch/estimator.py:335-338)

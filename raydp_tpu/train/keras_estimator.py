@@ -1,0 +1,251 @@
+"""KerasEstimator: the TFEstimator-parity trainer on Keras 3's JAX backend.
+
+Parity map (reference tf/estimator.py):
+
+- the estimator owns a serialized model *spec*, not a live object — the
+  reference serializes the model to JSON and optimizer/loss/metrics through
+  keras serialize (96-149) so they rebuild inside workers; here
+  ``keras.saving.serialize_keras_object`` round-trips them the same way.
+- ``train_func`` opens a ``tf.distribute.MultiWorkerMirroredStrategy`` scope →
+  compile → ``to_tf`` dataset → ``model.fit`` (171-210); here the strategy
+  scope becomes ``keras.distribution.DataParallel`` over the JAX device mesh —
+  collectives are XLA collectives over ICI, no TF runtime involved.
+- ``merge_feature_columns`` via ray.data ``Concatenator`` (237-260) — the host
+  feed stacks feature columns into one matrix the same way.
+- chief-only checkpoint (202-210) — process-0 saves ``model.keras`` per epoch.
+- same ``fit`` / ``fit_on_spark`` / ``get_model`` surface (212-310) —
+  ``fit`` / ``fit_on_frame`` / ``get_model`` below.
+
+Keras must run on the JAX backend; this module asserts it (the reference
+equally hard-requires TF inside its workers).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from raydp_tpu.log import get_logger
+from raydp_tpu.train.estimator import EstimatorInterface, FrameEstimatorInterface
+from raydp_tpu.train.flax_estimator import TrainingResult
+
+logger = get_logger("train.keras_estimator")
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+
+def _import_keras():
+    import keras
+
+    if keras.backend.backend() != "jax":
+        raise RuntimeError(
+            "raydp_tpu.KerasEstimator requires the JAX backend; set "
+            "KERAS_BACKEND=jax before the first keras import "
+            f"(found {keras.backend.backend()!r})")
+    return keras
+
+
+class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
+    """sklearn-style estimator for Keras models, SPMD over the device mesh."""
+
+    def __init__(
+        self,
+        model=None,
+        model_builder: Optional[Callable] = None,
+        optimizer="adam",
+        loss: Union[str, Callable] = "mse",
+        metrics: Optional[Sequence] = None,
+        feature_columns: Optional[Sequence[str]] = None,
+        label_column: Optional[str] = None,
+        batch_size: int = 64,
+        num_epochs: int = 10,
+        shuffle: bool = True,
+        data_parallel: bool = True,
+        checkpoint_dir: Optional[str] = None,
+        seed: int = 0,
+        feature_dtype=np.float32,
+        label_dtype=np.float32,
+        drop_last: bool = True,
+        fit_kwargs: Optional[Dict] = None,
+    ):
+        keras = _import_keras()
+        if model is None and model_builder is None:
+            raise ValueError("pass model or model_builder")
+        # serialize the spec so fit() rebuilds fresh objects each run
+        # (parity: tf/estimator.py:96-149 JSON/keras-serialize round-trip)
+        self._model_spec = (keras.saving.serialize_keras_object(model)
+                            if model is not None else None)
+        self._model_builder = model_builder
+        self._optimizer_spec = keras.saving.serialize_keras_object(
+            keras.optimizers.get(optimizer))
+        self._loss = loss
+        self._metrics = list(metrics or [])
+        self.feature_columns = list(feature_columns or [])
+        self.label_column = label_column
+        self.batch_size = batch_size
+        self.num_epochs = num_epochs
+        self.shuffle = shuffle
+        self.data_parallel = data_parallel
+        self.checkpoint_dir = checkpoint_dir
+        self.seed = seed
+        self.feature_dtype = feature_dtype
+        self.label_dtype = label_dtype
+        self.drop_last = drop_last
+        self.fit_kwargs = dict(fit_kwargs or {})
+        self._trained_model = None
+        self._result: Optional[TrainingResult] = None
+
+    # ------------------------------------------------------------------ build
+    def _build_model(self):
+        keras = _import_keras()
+        if self._model_spec is not None:
+            return keras.saving.deserialize_keras_object(self._model_spec)
+        return self._model_builder()
+
+    def _maybe_distribute(self):
+        """DataParallel over all local devices when >1 (the MWMS-scope
+        analogue, tf/estimator.py:173-176). Returns the caller's previous
+        distribution so ``fit`` can restore it."""
+        keras = _import_keras()
+        previous = keras.distribution.distribution()
+        import jax
+        if self.data_parallel and len(jax.devices()) > 1:
+            keras.distribution.set_distribution(
+                keras.distribution.DataParallel())
+        return previous
+
+    def _materialize(self, ds):
+        """Dataset → (features [n, d], labels [n]) host arrays.
+
+        Feature columns merge into one contiguous matrix (parity:
+        ``merge_feature_columns`` Concatenator, tf/estimator.py:237-260)."""
+        if ds is None:
+            return None
+        if not self.feature_columns or self.label_column is None:
+            raise ValueError("pass feature_columns and label_column")
+        table = ds.to_arrow()
+        feats = np.stack(
+            [table.column(c).to_numpy(zero_copy_only=False)
+             .astype(self.feature_dtype, copy=False)
+             for c in self.feature_columns], axis=1)
+        labels = (table.column(self.label_column)
+                  .to_numpy(zero_copy_only=False)
+                  .astype(self.label_dtype, copy=False))
+        return feats, labels
+
+    def _trim(self, arrays, n_devices: int):
+        """Static shapes under data parallelism: drop the ragged tail so every
+        batch splits evenly over devices (same reason the DeviceFeed drops
+        remainders — a changing batch dim retraces under jit)."""
+        feats, labels = arrays
+        if not self.drop_last:
+            return feats, labels
+        step = self.batch_size
+        n = (len(feats) // step) * step
+        if n == 0:
+            n = (len(feats) // n_devices) * n_devices
+        return (feats[:n], labels[:n]) if n else (feats, labels)
+
+    # -------------------------------------------------------------------- fit
+    def fit(self, train_ds, evaluate_ds=None, max_retries: int = 0
+            ) -> TrainingResult:
+        import jax
+        keras = _import_keras()
+
+        previous_distribution = self._maybe_distribute()
+        try:
+            keras.utils.set_random_seed(self.seed)
+            model = self._build_model()
+            optimizer = keras.saving.deserialize_keras_object(
+                self._optimizer_spec)
+            model.compile(optimizer=optimizer, loss=self._loss,
+                          metrics=list(self._metrics))
+
+            n_dev = len(jax.devices()) if self.data_parallel else 1
+            x, y = self._trim(self._materialize(train_ds), n_dev)
+            validation = self._materialize(evaluate_ds)
+            if validation is not None and n_dev > 1:
+                # validation batches must also split evenly over devices
+                vx, vy = validation
+                n = (len(vx) // n_dev) * n_dev
+                validation = (vx[:n], vy[:n]) if n else None
+
+            ckpt_dir = self.checkpoint_dir or tempfile.mkdtemp(
+                prefix="rdt-keras-ckpt-")
+            callbacks = []
+            if jax.process_index() == 0:
+                # chief-only checkpoint (parity: tf/estimator.py:202-210)
+                callbacks.append(keras.callbacks.ModelCheckpoint(
+                    os.path.join(ckpt_dir, "model.keras"),
+                    save_best_only=False))
+
+            attempt = 0
+            while True:
+                try:
+                    hist = model.fit(
+                        x, y, batch_size=self.batch_size,
+                        epochs=self.num_epochs,
+                        shuffle=self.shuffle,
+                        validation_data=validation,
+                        callbacks=callbacks,
+                        verbose=0,
+                        **self.fit_kwargs)
+                    break
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:  # noqa: BLE001 - FailureConfig parity
+                    attempt += 1
+                    if attempt > max_retries:
+                        raise
+                    saved = os.path.join(ckpt_dir, "model.keras")
+                    if jax.process_count() == 1 and os.path.exists(saved):
+                        logger.warning("keras fit failed (%s); retry %d/%d "
+                                       "from checkpoint", e, attempt,
+                                       max_retries)
+                        model = keras.saving.load_model(saved)
+                    else:
+                        # multi-host (or no checkpoint yet): a chief-only
+                        # checkpoint cannot restore every replica consistently,
+                        # so rebuild from the spec with the same seed — the
+                        # reference's replay-from-scratch semantics
+                        logger.warning("keras fit failed (%s); retry %d/%d "
+                                       "from scratch", e, attempt, max_retries)
+                        keras.utils.set_random_seed(self.seed)
+                        model = self._build_model()
+                        model.compile(
+                            optimizer=keras.saving.deserialize_keras_object(
+                                self._optimizer_spec),
+                            loss=self._loss, metrics=list(self._metrics))
+
+            history = [
+                {"epoch": i, **{k: float(v[i]) for k, v in hist.history.items()}}
+                for i in range(len(hist.epoch))
+            ]
+            self._trained_model = model
+            self._result = TrainingResult(state=model, history=history,
+                                          checkpoint_dir=ckpt_dir)
+            logger.info("keras fit done: %s",
+                        history[-1] if history else "{}")
+            return self._result
+        finally:
+            keras.distribution.set_distribution(previous_distribution)
+
+    # ----------------------------------------------------------- fit_on_frame
+    def fit_on_frame(self, train_df, evaluate_df=None, *,
+                     fs_directory: Optional[str] = None,
+                     stop_etl_after_conversion: bool = False,
+                     max_retries: int = 0) -> TrainingResult:
+        train_ds, eval_ds = self._convert_frames(
+            train_df, evaluate_df, fs_directory=fs_directory,
+            stop_etl_after_conversion=stop_etl_after_conversion)
+        return self.fit(train_ds, eval_ds, max_retries=max_retries)
+
+    # -------------------------------------------------------------- get_model
+    def get_model(self):
+        """The trained keras model (parity: tf/estimator.py:306-310)."""
+        if self._trained_model is None:
+            raise RuntimeError("call fit()/fit_on_frame() first")
+        return self._trained_model
