@@ -10,6 +10,9 @@
 
 from raydp_tpu.models.mlp import MLP, NYCTaxiModel
 from raydp_tpu.models.dlrm import DLRM, criteo_batch_preprocessor, dlrm_param_rules
+from raydp_tpu.models.gbdt import GBDTModel, fit_gbdt
+from raydp_tpu.models.transformer import TransformerLM, lm_loss
 
 __all__ = ["MLP", "NYCTaxiModel", "DLRM", "criteo_batch_preprocessor",
-           "dlrm_param_rules"]
+           "dlrm_param_rules", "GBDTModel", "fit_gbdt", "TransformerLM",
+           "lm_loss"]

@@ -1,0 +1,151 @@
+"""Decoder-only Transformer LM — the long-context model family.
+
+The reference exercises only MLPs/DLRM over tabular data and ships no
+sequence parallelism (SURVEY.md §2.4, §5 "long-context: absent"); this model is
+the capability the TPU build adds on top of parity. The attention layer
+dispatches by configuration:
+
+- ``attention="ring"`` — exact attention over a sequence-sharded batch via
+  :func:`raydp_tpu.ops.ring_attention.ring_attention_sharded`: K/V blocks
+  rotate around the mesh's ``seq`` axis with ``ppermute`` (ICI neighbor links),
+  memory O(T / seq_devices) per device;
+- ``attention="flash"`` — single-device memory-efficient attention via the
+  first-party Pallas kernel (:mod:`raydp_tpu.ops.flash_attention`);
+- ``attention="dense"`` — reference path for tests;
+- ``attention="auto"`` — ring when the mesh has a ``seq`` axis > 1, else flash
+  on TPU, else dense.
+
+Architecture: pre-RMSNorm blocks, rotary position embeddings, SwiGLU MLP —
+all plain dense ops XLA tiles onto the MXU; bf16-friendly throughout
+(``dtype`` controls activations, params stay f32 for stable optimization).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+
+def rotary_embedding(x: jnp.ndarray, positions: jnp.ndarray,
+                     base: float = 10000.0) -> jnp.ndarray:
+    """Apply RoPE. x: [B, T, H, D]; positions: [T] global token positions."""
+    d_half = x.shape[-1] // 2
+    freqs = 1.0 / (base ** (np.arange(0, d_half) / d_half))
+    angles = positions[:, None] * freqs[None, :]            # [T, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :d_half], x[..., d_half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        return (x * jax.lax.rsqrt(var + self.eps)).astype(x.dtype) * scale
+
+
+class Attention(nn.Module):
+    num_heads: int
+    attention: str = "auto"
+    mesh: Any = None
+    dtype: Any = jnp.float32
+
+    def _dispatch(self) -> str:
+        if self.attention != "auto":
+            return self.attention
+        if (self.mesh is not None and "seq" in self.mesh.axis_names
+                and self.mesh.shape["seq"] > 1):
+            return "ring"
+        return "flash" if jax.default_backend() == "tpu" else "dense"
+
+    @nn.compact
+    def __call__(self, x):
+        from raydp_tpu.ops.flash_attention import flash_attention
+        from raydp_tpu.ops.ring_attention import (
+            dense_attention, ring_attention_sharded)
+
+        b, t, dim = x.shape
+        head_dim = dim // self.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (self.num_heads, head_dim), axis=-1, name=name, dtype=self.dtype,
+            use_bias=False)
+        q, k, v = dense("q")(x), dense("k")(x), dense("v")(x)
+
+        positions = jnp.arange(t)
+        q = rotary_embedding(q, positions)
+        k = rotary_embedding(k, positions)
+
+        kind = self._dispatch()
+        if kind == "ring":
+            out = ring_attention_sharded(q, k, v, self.mesh, causal=True)
+        elif kind == "flash":
+            out = flash_attention(q, k, v, causal=True)
+        else:
+            out = dense_attention(q, k, v, causal=True)
+        return nn.DenseGeneral(dim, axis=(-2, -1), name="o", dtype=self.dtype,
+                               use_bias=False)(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    attention: str = "auto"
+    mesh: Any = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dim = x.shape[-1]
+        x = x + Attention(self.num_heads, self.attention, self.mesh,
+                          self.dtype, name="attn")(RMSNorm(name="ln1")(x))
+        h = RMSNorm(name="ln2")(x)
+        hidden = self.mlp_ratio * dim
+        # SwiGLU
+        gate = nn.Dense(hidden, use_bias=False, dtype=self.dtype,
+                        name="gate")(h)
+        up = nn.Dense(hidden, use_bias=False, dtype=self.dtype, name="up")(h)
+        down = nn.Dense(dim, use_bias=False, dtype=self.dtype,
+                        name="down")(nn.silu(gate) * up)
+        return x + down
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: tokens [B, T] int32 → logits [B, T, vocab]."""
+
+    vocab_size: int
+    dim: int = 256
+    num_heads: int = 4
+    num_layers: int = 2
+    mlp_ratio: int = 4
+    attention: str = "auto"
+    mesh: Any = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        x = nn.Embed(self.vocab_size, self.dim, name="embed",
+                     dtype=self.dtype)(tokens)
+        for i in range(self.num_layers):
+            x = Block(self.num_heads, self.mlp_ratio, self.attention,
+                      self.mesh, self.dtype, name=f"block_{i}")(x)
+        x = RMSNorm(name="ln_f")(x)
+        return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
+                        name="lm_head")(x).astype(jnp.float32)
+
+
+def lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Next-token cross entropy (shifted); tokens [B, T], logits [B, T, V]."""
+    import optax
+
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], tokens[:, 1:]).mean()

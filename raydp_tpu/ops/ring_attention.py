@@ -9,8 +9,9 @@ neighbor links — the layout the hardware gives ring ``ppermute`` for free.
 
 The reference framework has no sequence parallelism at all (SURVEY.md §2.4: "every
 other strategy is absent") — this op is the long-context capability the TPU build
-adds. Local block attention dispatches to the pallas flash kernel on TPU
-(:mod:`raydp_tpu.ops.flash_attention`) and to a fused jnp path elsewhere.
+adds. Local block attention is a fused online-softmax update in plain jnp (one
+[B, H, T/n, T/n] score block per ring step); the single-device memory-efficient
+kernel lives separately in :mod:`raydp_tpu.ops.flash_attention`.
 """
 
 from __future__ import annotations
@@ -60,14 +61,18 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
     m0 = jnp.full((b, h, t_local), -jnp.inf, dtype=jnp.float32)
     l0 = jnp.zeros((b, h, t_local), dtype=jnp.float32)
     acc0 = jnp.zeros((b, t_local, h, d), dtype=jnp.float32)
-    if hasattr(lax, "pvary"):
+    if hasattr(lax, "pcast") or hasattr(lax, "pvary"):
         # newer jax tracks varying-manual-axes through shard_map: the carry
         # inits must vary over the same axes as the inputs they mix with
         try:
             vma = tuple(jax.typeof(q).vma) or (axis_name,)
         except Exception:
             vma = (axis_name,)
-        m0, l0, acc0 = (lax.pvary(x, vma) for x in (m0, l0, acc0))
+        if hasattr(lax, "pcast"):
+            m0, l0, acc0 = (lax.pcast(x, vma, to="varying")
+                            for x in (m0, l0, acc0))
+        else:
+            m0, l0, acc0 = (lax.pvary(x, vma) for x in (m0, l0, acc0))
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 

@@ -1,0 +1,128 @@
+"""Transformer LM + flash attention tests.
+
+Covers the long-context tier: flash kernel vs dense reference (fwd + grad,
+both the jnp path and the Pallas kernel in interpret mode), ring-vs-dense
+equivalence through the full model on a sequence-sharded mesh, and a short
+training-loss check.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raydp_tpu.ops.flash_attention import flash_attention
+from raydp_tpu.ops.ring_attention import dense_attention
+
+
+def _qkv(b=2, t=128, h=2, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rng.randn(b, t, h, d).astype(np.float32)) * 0.3
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_dense(causal):
+    q, k, v = _qkv()
+    ref = dense_attention(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_pallas_interpret_matches_dense():
+    q, k, v = _qkv(t=256, d=128)
+    ref = dense_attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_grads_match_dense():
+    q, k, v = _qkv(t=64)
+
+    def loss(f):
+        return lambda q, k, v: jnp.sum(f(q, k, v, causal=True) ** 2)
+
+    g_ref = jax.grad(loss(dense_attention), argnums=(0, 1, 2))(q, k, v)
+    g_got = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_got):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-4)
+
+
+def _tokens(b, t, vocab, seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randint(0, vocab, size=(b, t)).astype(np.int32))
+
+
+def test_lm_forward_shapes():
+    from raydp_tpu.models import TransformerLM
+
+    model = TransformerLM(vocab_size=64, dim=32, num_heads=2, num_layers=2,
+                          attention="dense")
+    tokens = _tokens(2, 16, 64)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    logits = model.apply(variables, tokens)
+    assert logits.shape == (2, 16, 64)
+    assert logits.dtype == jnp.float32
+
+
+def test_lm_ring_matches_dense_on_mesh():
+    """Full model, sequence sharded over seq=4: ring attention output equals
+    the dense single-device reference."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from raydp_tpu.models import TransformerLM
+    from raydp_tpu.parallel import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(data=2, seq=4))
+    vocab, b, t = 64, 4, 32
+
+    dense_model = TransformerLM(vocab_size=vocab, dim=32, num_heads=2,
+                                num_layers=2, attention="dense")
+    ring_model = TransformerLM(vocab_size=vocab, dim=32, num_heads=2,
+                               num_layers=2, attention="ring", mesh=mesh)
+    tokens = _tokens(b, t, vocab)
+    variables = dense_model.init(jax.random.PRNGKey(0), tokens)
+
+    ref = dense_model.apply(variables, tokens)
+
+    sharded_tokens = jax.device_put(
+        tokens, NamedSharding(mesh, P("data", "seq")))
+    with mesh:
+        got = jax.jit(ring_model.apply)(variables, sharded_tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_lm_training_reduces_loss():
+    import optax
+
+    from raydp_tpu.models import TransformerLM, lm_loss
+
+    vocab = 32
+    model = TransformerLM(vocab_size=vocab, dim=64, num_heads=2, num_layers=2,
+                          attention="dense")
+    # learnable structure: next token = (token + 1) % vocab
+    rng = np.random.RandomState(0)
+    start = rng.randint(0, vocab, size=(64, 1))
+    tokens = jnp.asarray((start + np.arange(24)[None, :]) % vocab,
+                         dtype=jnp.int32)
+
+    variables = model.init(jax.random.PRNGKey(0), tokens[:, :1])
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(variables["params"])
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        def loss_fn(p):
+            return lm_loss(model.apply({"params": p}, batch), batch)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params = variables["params"]
+    losses = []
+    for i in range(30):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < 0.3 * losses[0], losses[::10]
