@@ -115,8 +115,12 @@ class EtlExecutor:
     # -- compute ---------------------------------------------------------------
     def run_task(self, task_bytes: bytes) -> Dict[str, Any]:
         """Execute one task; the return shape depends on the task's output mode."""
+        from raydp_tpu import profiler
+
         task: T.Task = cloudpickle.loads(task_bytes)
-        table = T.run_task_body(task)
+        with profiler.trace(f"task:{type(task.source).__name__}", "etl",
+                            task_id=task.task_id):
+            table = T.run_task_body(task)
         client = get_client()
         owner = task.owner
 

@@ -47,6 +47,7 @@ class Session:
         self.engine: Optional[Engine] = None
         self._cached_frames: Dict[str, P.CachedScan] = {}
         self._stopped = False
+        self._next_executor_index = 0
 
     # ---- lifecycle ----------------------------------------------------------
     def start(self) -> "Session":
@@ -57,29 +58,8 @@ class Session:
             EtlMaster, (self.app_name,), name=self.master_name,
             resources=master_resources, max_restarts=0, max_concurrency=8)
 
-        executor_resources = {"CPU": float(self.executor_cores),
-                              "memory": float(self.executor_memory)}
-        executor_resources.update(
-            self.config.resource_map(cfg.EXECUTOR_ACTOR_RESOURCE_PREFIX))
-        max_restarts = self.config.get_int(cfg.EXECUTOR_RESTARTS_KEY, -1)
-
-        for i in range(self.num_executors):
-            pg_id, bundle = None, None
-            if self.placement_group is not None:
-                pg_id = self.placement_group.group_id
-                bundle = i % len(self.placement_group.bundles)
-            handle = rt.create_actor(
-                EtlExecutor, (self.master_name,),
-                name=f"rdt-executor-{self.app_name}-{i}",
-                resources=executor_resources,
-                max_restarts=max_restarts,
-                max_concurrency=max(2, self.executor_cores),
-                env={"JAX_PLATFORMS": "cpu"},  # ETL actors must never grab TPU chips
-                placement_group=pg_id,
-                bundle_index=bundle,
-                block=False,
-            )
-            self.executors.append(handle)
+        for _ in range(self.num_executors):
+            self.executors.append(self._launch_executor(block=False))
         for h in self.executors:
             h.wait_ready()
 
@@ -92,6 +72,60 @@ class Session:
         logger.info("session %s started: master + %d executors",
                     self.app_name, len(self.executors))
         return self
+
+    def _launch_executor(self, block: bool = True) -> ActorHandle:
+        rt = get_runtime()
+        executor_resources = {"CPU": float(self.executor_cores),
+                              "memory": float(self.executor_memory)}
+        executor_resources.update(
+            self.config.resource_map(cfg.EXECUTOR_ACTOR_RESOURCE_PREFIX))
+        max_restarts = self.config.get_int(cfg.EXECUTOR_RESTARTS_KEY, -1)
+        i = self._next_executor_index
+        self._next_executor_index += 1
+        pg_id, bundle = None, None
+        if self.placement_group is not None:
+            pg_id = self.placement_group.group_id
+            bundle = i % len(self.placement_group.bundles)
+        return rt.create_actor(
+            EtlExecutor, (self.master_name,),
+            name=f"rdt-executor-{self.app_name}-{i}",
+            resources=executor_resources,
+            max_restarts=max_restarts,
+            max_concurrency=max(2, self.executor_cores),
+            env={"JAX_PLATFORMS": "cpu"},  # ETL actors must never grab TPU chips
+            placement_group=pg_id,
+            bundle_index=bundle,
+            block=block,
+        )
+
+    # ---- dynamic allocation -------------------------------------------------
+    def request_total_executors(self, total: int) -> int:
+        """Scale the executor gang to ``total`` live executors.
+
+        Parity: Spark dynamic allocation routed to actor create/kill —
+        ``doRequestTotalExecutors`` / ``doKillExecutors``
+        (RayCoarseGrainedSchedulerBackend.scala:278-301, RayAppMaster.scala:
+        173-190, 275-288). Shrinking kills the newest executors (their cached
+        blocks recover through lineage on the survivors)."""
+        if total < 1:
+            raise ValueError("need at least one executor")
+        while len(self.executors) > total:
+            handle = self.executors.pop()
+            try:
+                handle.kill(no_restart=True)
+            except Exception:
+                pass
+        added = []
+        while len(self.executors) + len(added) < total:
+            added.append(self._launch_executor(block=False))
+        for h in added:
+            h.wait_ready()
+        self.executors.extend(added)
+        if self.engine is not None:
+            self.engine.pool = ExecutorPool(self.executors)
+        logger.info("session %s scaled to %d executors", self.app_name,
+                    len(self.executors))
+        return len(self.executors)
 
     def stop(self, cleanup_data: bool = True) -> None:
         """Idempotent; a later ``stop(cleanup_data=True)`` after a keep-data stop

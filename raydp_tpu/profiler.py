@@ -1,0 +1,143 @@
+"""Tracing/profiling subsystem.
+
+The reference has **no** tracer or profiler hooks anywhere (SURVEY.md §5:
+"Tracing / profiling: none" — its only timing code is an unreported wall-clock
+helper in examples/pytorch_dlrm.ipynb). This module is deliberately beyond
+parity:
+
+- :func:`trace` — a span context manager usable in any session process (driver,
+  ETL executor, SPMD rank); spans buffer process-locally with zero contention
+  beyond a lock append.
+- :func:`collect_chrome_trace` — merges the driver's spans with every live
+  actor's (fetched over actor RPC) into one Chrome ``chrome://tracing`` /
+  Perfetto JSON, one "process" lane per actor role.
+- :func:`jax_trace` — wraps ``jax.profiler.trace`` so device-level XLA traces
+  (TensorBoard format) land in the session directory next to the span trace.
+
+The ETL executor wraps task execution in a span and the Flax estimator wraps
+each epoch, so an unmodified user program already yields a usable timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_lock = threading.Lock()
+_spans: List[Dict[str, Any]] = []
+_enabled = True
+
+
+def set_enabled(value: bool) -> None:
+    global _enabled
+    _enabled = value
+
+
+@contextlib.contextmanager
+def trace(name: str, category: str = "app", **args):
+    """Record a wall-clock span around the body (no-op when disabled)."""
+    if not _enabled:
+        yield
+        return
+    start = time.time_ns()
+    try:
+        yield
+    finally:
+        end = time.time_ns()
+        span = {
+            "name": name,
+            "cat": category,
+            "ts": start // 1000,          # chrome trace wants microseconds
+            "dur": (end - start) // 1000,
+            "ph": "X",
+            "tid": threading.get_ident() % 1_000_000,
+        }
+        if args:
+            span["args"] = {k: str(v) for k, v in args.items()}
+        with _lock:
+            _spans.append(span)
+
+
+def spans() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_spans)
+
+
+def clear() -> None:
+    with _lock:
+        _spans.clear()
+
+
+def _label_spans(span_list: List[Dict[str, Any]], role: str,
+                 pid: int) -> List[Dict[str, Any]]:
+    out = []
+    for s in span_list:
+        s = dict(s)
+        s["pid"] = pid
+        out.append(s)
+    out.append({"name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": role}})
+    return out
+
+
+def collect_chrome_trace(path: Optional[str] = None,
+                         include_actors: bool = True) -> str:
+    """Write a merged Chrome-trace JSON; returns the output path.
+
+    The driver's spans get pid 0; each live actor contributes its buffer as a
+    separate pid lane (actors expose it through the ``__rdt_spans__``
+    intrinsic). Dead actors' spans are lost — collect before teardown."""
+    events = _label_spans(spans(), "driver", 0)
+
+    from raydp_tpu.runtime import head as head_mod
+
+    session_dir = "/tmp/raydp_tpu"
+    if head_mod.runtime_initialized():
+        rt = head_mod.get_runtime()
+        session_dir = rt.session_dir
+        if include_actors:
+            from raydp_tpu.runtime.actor import ActorHandle
+            pid = 1
+            for aid, rec in list(rt.records.items()):
+                if rec.state != "ALIVE":
+                    continue
+                role = rec.spec.name or aid
+                try:
+                    handle = ActorHandle(aid, rec.spec.name, rt.server.address)
+                    actor_spans = handle.call("__rdt_spans__", timeout=10.0)
+                    events.extend(_label_spans(actor_spans, role, pid))
+                except Exception:
+                    pass
+                pid += 1
+
+    if path is None:
+        os.makedirs(os.path.join(session_dir, "traces"), exist_ok=True)
+        path = os.path.join(session_dir, "traces", "trace.json")
+    else:
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+    return path
+
+
+@contextlib.contextmanager
+def jax_trace(log_dir: Optional[str] = None):
+    """Capture an XLA device trace (TensorBoard profile) around the body."""
+    import jax
+
+    if log_dir is None:
+        from raydp_tpu.runtime import head as head_mod
+        base = (head_mod.get_runtime().session_dir
+                if head_mod.runtime_initialized() else "/tmp/raydp_tpu")
+        log_dir = os.path.join(base, "traces", "jax")
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()

@@ -58,6 +58,9 @@ class _ActorServer:
         if method == "__rdt_shutdown__":
             threading.Thread(target=_delayed_exit, daemon=True).start()
             return True
+        if method == "__rdt_spans__":
+            from raydp_tpu import profiler
+            return profiler.spans()
         return self._dispatch(method, args, kwargs)
 
 

@@ -298,6 +298,8 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         history: List[Dict[str, float]] = []
         epoch = 0
         retries = 0
+        from raydp_tpu import profiler
+
         while epoch < self.num_epochs:
             try:
                 t0 = time.perf_counter()
