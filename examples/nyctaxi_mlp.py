@@ -3,6 +3,12 @@ example (examples/pytorch_nyctaxi.py): CSV → distributed feature ETL on CPU
 actors → recoverable Arrow handoff → pjit-compiled MLP training on TPU.
 
 Run: python examples/nyctaxi_mlp.py [--rows 100000] [--epochs 5]
+
+``--num-workers N`` (N>1) trains as a gang of N processes under one
+``jax.distributed`` mesh — the reference's multi-worker Ray Train path
+(ScalingConfig(num_workers), torch/estimator.py:312-356). On a TPU pod this is
+one process per host; on CPU it demonstrates the same code path with virtual
+devices.
 """
 
 from __future__ import annotations
@@ -29,6 +35,8 @@ def main():
     ap.add_argument("--epochs", type=int, default=5)
     ap.add_argument("--batch-size", type=int, default=1024)
     ap.add_argument("--num-executors", type=int, default=2)
+    ap.add_argument("--num-workers", type=int, default=1,
+                    help=">1 trains as a jax.distributed process gang")
     ap.add_argument("--csv", default=None)
     args = ap.parse_args()
 
@@ -58,7 +66,8 @@ def main():
             num_epochs=args.epochs,
             metrics=["mae", "mse"],
         )
-        result = estimator.fit_on_frame(train_df, test_df)
+        result = estimator.fit_on_frame(train_df, test_df,
+                                        num_workers=args.num_workers)
         for row in result.history:
             print(row)
     finally:

@@ -78,9 +78,9 @@ class DistributedDataset:
             return meta.ref
         assert meta.cache_key is not None and self._session is not None
         last_err: Optional[Exception] = None
-        for _ in range(max_retries):
+        for attempt in range(max_retries):
             try:
-                executor = self._resolve_executor(meta)
+                executor = self._resolve_executor(meta, attempt)
                 out = executor.get_block(meta.cache_key, meta.recover,
                                          self._owner)
                 meta.ref = out["ref"]
@@ -94,14 +94,23 @@ class DistributedDataset:
         raise RuntimeError(
             f"could not fetch block {i} ({meta.cache_key})") from last_err
 
-    def _resolve_executor(self, meta: BlockMeta):
+    def _resolve_executor(self, meta: BlockMeta, attempt: int = 0):
         from raydp_tpu.runtime import get_runtime
         rt = get_runtime()
         handle = rt.get_actor(meta.executor) if meta.executor else None
         if handle is None:
-            # executor gone for good: run the recipe on any live executor
+            # executor gone for good: fan recovery out across live executors
+            # (hash spread + attempt rotation) instead of serializing all
+            # recovery through one actor (the reference schedules fetch tasks
+            # anywhere, dataset.py:203-220)
             if self._session is not None and self._session.executors:
-                handle = self._session.executors[0]
+                import zlib
+                pool = self._session.executors
+                # crc32, not hash(): str hashes are per-process randomized,
+                # and every reader process should converge on the same
+                # executor per block so a lost block is recovered once
+                idx = (zlib.crc32(meta.cache_key.encode()) + attempt) % len(pool)
+                handle = pool[idx]
             else:
                 raise RuntimeError(f"no executor to serve block {meta.cache_key}")
         return handle
@@ -175,6 +184,27 @@ class DistributedDataset:
                     taken[block_idx] = n - take
             plans.append(plan)
         return plans
+
+    # ---- portability --------------------------------------------------------
+    def portable(self) -> Dict:
+        """A picklable descriptor another session process (e.g. an SPMD rank)
+        can rebuild this dataset from. Forces every block into the object
+        store first, so readers need only a store client — no session, no
+        executors (parity: the holder-actor handoff, dataset.py:239-313)."""
+        refs = [self.get_block_ref(i) for i in range(self.num_blocks())]
+        return {
+            "refs": refs,
+            "rows": self.block_sizes(),
+            "schema": self._schema.serialize().to_pybytes(),
+        }
+
+    @staticmethod
+    def from_portable(payload: Dict) -> "DistributedDataset":
+        """Rebuild from :meth:`portable` in a process with a live store client."""
+        schema = pa.ipc.read_schema(pa.py_buffer(payload["schema"]))
+        blocks = [BlockMeta(num_rows=n, ref=r)
+                  for r, n in zip(payload["refs"], payload["rows"])]
+        return DistributedDataset(blocks, schema)
 
     # ---- lifecycle ----------------------------------------------------------
     def release(self) -> None:

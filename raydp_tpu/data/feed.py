@@ -44,6 +44,14 @@ class ShardSpec:
 ColumnSpec = Union[str, Sequence[str]]
 
 
+def _normalize_columns(columns: Dict[str, Tuple[ColumnSpec, np.dtype]]
+                       ) -> Dict[str, Tuple[Tuple[str, ...], np.dtype]]:
+    return {
+        name: ((cols,) if isinstance(cols, str) else tuple(cols), np.dtype(dt))
+        for name, (cols, dt) in columns.items()
+    }
+
+
 def _as_numpy(table: pa.Table, columns: Sequence[str], dtype) -> np.ndarray:
     """Stack columns into [rows, len(columns)] (or [rows] for one column)."""
     arrays = []
@@ -70,10 +78,7 @@ class HostBatchIterator:
     ):
         self.dataset = dataset
         self.batch_size = batch_size
-        self.columns = {
-            name: ((cols,) if isinstance(cols, str) else tuple(cols), np.dtype(dt))
-            for name, (cols, dt) in columns.items()
-        }
+        self.columns = _normalize_columns(columns)
         self.shard = shard
         self.shuffle = shuffle
         self.seed = seed
@@ -116,6 +121,82 @@ class HostBatchIterator:
         return batch, rest, buffered - self.batch_size
 
 
+class GangShardIterator:
+    """Per-rank host batches that compose into globally-consistent batches.
+
+    Global batch ``k`` covers dataset rows ``[k*B, (k+1)*B)`` in block order —
+    exactly the batches a single-process :class:`HostBatchIterator` with
+    ``shuffle=False`` cuts — and rank ``r`` of ``w`` yields the
+    ``[r*B/w, (r+1)*B/w)`` slice of each. All ranks permute the *batch order*
+    with the same seed (no within-block shuffling), so every rank walks the
+    same global batch sequence and ``jax.make_array_from_process_local_data``
+    assembles the intended global array. This is the multi-host analogue of
+    the reference's per-worker dataset shard (torch/estimator.py:226-241 via
+    ``divide_blocks``), strengthened to give bit-identical global batches for
+    any world size.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        global_batch: int,
+        world_size: int,
+        rank: int,
+        columns: Dict[str, Tuple[ColumnSpec, np.dtype]],
+        shuffle: bool = False,
+        seed: int = 0,
+    ):
+        if global_batch % world_size != 0:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by world size "
+                f"{world_size}")
+        if not (0 <= rank < world_size):
+            raise ValueError(f"rank {rank} out of range for world {world_size}")
+        self.dataset = dataset
+        self.global_batch = global_batch
+        self.world_size = world_size
+        self.rank = rank
+        self.columns = _normalize_columns(columns)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.per_rank = global_batch // world_size
+        self._starts = np.cumsum([0] + list(dataset.block_sizes()))
+        self.total = int(self._starts[-1])
+
+    def __len__(self) -> int:
+        return self.total // self.global_batch
+
+    def _runs(self, start: int, stop: int) -> List[Tuple[int, int, int]]:
+        """Global row range → list of (block_index, offset, length) runs."""
+        runs: List[Tuple[int, int, int]] = []
+        b = int(np.searchsorted(self._starts, start, side="right")) - 1
+        row = start
+        while row < stop:
+            blk_end = int(self._starts[b + 1])
+            take = min(stop, blk_end) - row
+            runs.append((b, row - int(self._starts[b]), take))
+            row += take
+            b += 1
+        return runs
+
+    def __iter__(self):
+        order = np.arange(len(self))
+        if self.shuffle:
+            np.random.RandomState(self.seed).shuffle(order)
+        tables: Dict[int, pa.Table] = {}  # zero-copy views, live for the epoch
+        for k in order:
+            start = int(k) * self.global_batch + self.rank * self.per_rank
+            parts = []
+            for b, off, length in self._runs(start, start + self.per_rank):
+                t = tables.get(b)
+                if t is None:
+                    t = tables[b] = self.dataset.get_block(b, zero_copy=True)
+                parts.append(t.slice(off, length))
+            tbl = pa.concat_tables(parts) if len(parts) > 1 else parts[0]
+            yield {name: _as_numpy(tbl, cols, dt)
+                   for name, (cols, dt) in self.columns.items()}
+
+
 class DeviceFeed:
     """Prefetching iterator of device-sharded batches over a mesh data axis."""
 
@@ -131,12 +212,13 @@ class DeviceFeed:
         seed: int = 0,
         prefetch: int = 2,
         drop_remainder: bool = True,
+        host_iter=None,
     ):
         import jax
         self._jax = jax
         self.mesh = mesh
         self.data_axis = data_axis
-        self.host_iter = HostBatchIterator(
+        self.host_iter = host_iter if host_iter is not None else HostBatchIterator(
             dataset, batch_size, columns, shard=shard, shuffle=shuffle,
             seed=seed, drop_remainder=drop_remainder)
         self.prefetch = max(1, prefetch)

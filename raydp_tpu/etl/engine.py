@@ -139,21 +139,19 @@ class ExecutorPool:
 
 
 class Engine:
+    """Thread-safe: shuffle intermediates are tracked in a per-action list
+    threaded through compilation (two concurrent actions on one session must
+    not cross-free each other's intermediates — the reference's Spark driver
+    supports concurrent actions)."""
+
     def __init__(self, pool: ExecutorPool, shuffle_partitions: int = 8,
                  owner: Optional[str] = None):
         self.pool = pool
         self.shuffle_partitions = shuffle_partitions
         self.owner = owner
-        # shuffle intermediates created while compiling the current action;
-        # freed when the action finishes (or pinned for cached frames)
-        self._temp_refs: List[ObjectRef] = []
 
-    def _drain_temps(self) -> List[ObjectRef]:
-        temps, self._temp_refs = self._temp_refs, []
-        return temps
-
-    def _free_temps(self) -> None:
-        temps = self._drain_temps()
+    @staticmethod
+    def _free(temps: List[ObjectRef]) -> None:
         if temps:
             try:
                 get_client().free(temps)
@@ -164,13 +162,15 @@ class Engine:
     def materialize(self, node: P.PlanNode, owner: Optional[str] = None
                     ) -> Tuple[List[ObjectRef], Optional[bytes], List[int]]:
         """Execute the plan; return per-partition (refs, schema bytes, row counts)."""
+        temps: List[ObjectRef] = []
         try:
-            return self._materialize_inner(node, owner)
+            return self._materialize_inner(node, owner, temps)
         finally:
-            self._free_temps()
+            self._free(temps)
 
-    def _materialize_inner(self, node: P.PlanNode, owner: Optional[str] = None):
-        tasks, preferred = self._compile(node)
+    def _materialize_inner(self, node: P.PlanNode, owner: Optional[str],
+                           temps: List[ObjectRef]):
+        tasks, preferred = self._compile(node, temps)
         tasks = [t.with_output(output=T.RETURN_REF, owner=owner or self.owner)
                  for t in tasks]
         results = self.pool.run_tasks(tasks, preferred)
@@ -180,8 +180,9 @@ class Engine:
         return refs, schema, num_rows
 
     def collect(self, node: P.PlanNode) -> pa.Table:
+        temps: List[ObjectRef] = []
         try:
-            tasks, preferred = self._compile(node)
+            tasks, preferred = self._compile(node, temps)
             tasks = [t.with_output(output=T.COLLECT) for t in tasks]
             results = self.pool.run_tasks(tasks, preferred)
             tables = [pa.ipc.open_stream(pa.py_buffer(r["ipc"])).read_all()
@@ -190,18 +191,19 @@ class Engine:
             limit = _root_limit(node)
             return out.slice(0, limit) if limit is not None else out
         finally:
-            self._free_temps()
+            self._free(temps)
 
     def count(self, node: P.PlanNode) -> int:
+        temps: List[ObjectRef] = []
         try:
-            tasks, preferred = self._compile(node)
+            tasks, preferred = self._compile(node, temps)
             tasks = [t.with_output(output=T.ROWCOUNT) for t in tasks]
             results = self.pool.run_tasks(tasks, preferred)
             total = sum(r["num_rows"] for r in results)
             limit = _root_limit(node)
             return min(total, limit) if limit is not None else total
         finally:
-            self._free_temps()
+            self._free(temps)
 
     def cache(self, node: P.PlanNode, frame_id: str) -> P.CachedScan:
         """Materialize into executor block caches with lineage recipes.
@@ -214,8 +216,9 @@ class Engine:
         them — they are released with the frame (the GC-pin of
         ObjectStoreWriter.scala:175-177).
         """
+        temps: List[ObjectRef] = []
         try:
-            tasks, preferred = self._compile(node)
+            tasks, preferred = self._compile(node, temps)
             cache_tasks, recover_blobs, keys = [], [], []
             for i, t in enumerate(tasks):
                 key = f"block_{frame_id}_{i}"
@@ -224,25 +227,29 @@ class Engine:
                 keys.append(key)
                 cache_tasks.append(t.with_output(output=T.CACHE, cache_key=key))
             results = self.pool.run_tasks(cache_tasks, preferred)
-            executors = [r["executor"] for r in results]
-            schema = results[0]["schema"] if results else None
-            return P.CachedScan(frame_id=frame_id, cache_keys=keys,
-                                executors=executors, recover_tasks=recover_blobs,
-                                schema=schema, pinned_refs=self._drain_temps())
-        finally:
-            self._free_temps()
+        except BaseException:
+            self._free(temps)
+            raise
+        executors = [r["executor"] for r in results]
+        schema = results[0]["schema"] if results else None
+        # temps stay pinned: the lineage recipes reference them
+        return P.CachedScan(frame_id=frame_id, cache_keys=keys,
+                            executors=executors, recover_tasks=recover_blobs,
+                            schema=schema, pinned_refs=temps)
 
     def num_partitions(self, node: P.PlanNode) -> int:
+        temps: List[ObjectRef] = []
         try:
-            tasks, _ = self._compile(node)
+            tasks, _ = self._compile(node, temps)
             return len(tasks)
         finally:
-            self._free_temps()
+            self._free(temps)
 
     # ---- compilation --------------------------------------------------------
-    def _compile(self, node: P.PlanNode
+    def _compile(self, node: P.PlanNode, temps: List[ObjectRef]
                  ) -> Tuple[List[T.Task], List[Optional[str]]]:
-        """Return (tasks, preferred-executor-per-task)."""
+        """Return (tasks, preferred-executor-per-task); shuffle intermediates
+        created along the way are appended to ``temps`` (per-action list)."""
         if isinstance(node, P.RangeScan):
             per = math.ceil((node.stop - node.start) / max(node.step, 1)
                             / node.num_partitions)
@@ -283,19 +290,19 @@ class Engine:
         }
         for cls, make in narrow.items():
             if isinstance(node, cls):
-                tasks, preferred = self._compile(node.child)
+                tasks, preferred = self._compile(node.child, temps)
                 step = make(node)
                 return [t.with_output(steps=t.steps + [step]) for t in tasks], preferred
 
         if isinstance(node, P.Sample):
-            tasks, preferred = self._compile(node.child)
+            tasks, preferred = self._compile(node.child, temps)
             out = [t.with_output(steps=t.steps + [
                 T.SampleStep(node.fraction, node.seed, i)])
                 for i, t in enumerate(tasks)]
             return out, preferred
 
         if isinstance(node, P.SplitSelect):
-            tasks, preferred = self._compile(node.child)
+            tasks, preferred = self._compile(node.child, temps)
             out = [t.with_output(steps=t.steps + [
                 T.SplitSelectStep(node.lo, node.hi, node.seed, i)])
                 for i, t in enumerate(tasks)]
@@ -303,21 +310,21 @@ class Engine:
 
         # ---- wide: execute child, shuffle through the object store ----
         if isinstance(node, P.Repartition):
-            return self._compile_repartition(node)
+            return self._compile_repartition(node, temps)
 
         if isinstance(node, P.GroupAgg):
-            return self._compile_groupagg(node)
+            return self._compile_groupagg(node, temps)
 
         if isinstance(node, P.Join):
-            return self._compile_join(node)
+            return self._compile_join(node, temps)
 
         if isinstance(node, P.Sort):
-            return self._compile_sort(node)
+            return self._compile_sort(node, temps)
 
         if isinstance(node, P.Union):
             all_tasks, all_pref = [], []
             for child in node.inputs:
-                tasks, preferred = self._compile(child)
+                tasks, preferred = self._compile(child, temps)
                 all_tasks.extend(tasks)
                 all_pref.extend(preferred)
             return all_tasks, all_pref
@@ -361,10 +368,10 @@ class Engine:
 
     # ---- wide operators -----------------------------------------------------
     def _shuffle_children(self, node: P.PlanNode, num_buckets: int,
-                          keys: Optional[List[str]],
+                          keys: Optional[List[str]], temps: List[ObjectRef],
                           range_key=None) -> Tuple[List[List[ObjectRef]], Optional[bytes]]:
         """Execute ``node`` with SHUFFLE output; transpose map×bucket → bucket×map."""
-        tasks, preferred = self._compile(node)
+        tasks, preferred = self._compile(node, temps)
         tasks = [t.with_output(output=T.SHUFFLE, num_buckets=num_buckets,
                                shuffle_keys=keys, range_key=range_key,
                                owner=self.owner)
@@ -375,37 +382,39 @@ class Engine:
         for r in results:
             for b, ref in enumerate(r["bucket_refs"]):
                 buckets[b].append(ref)
-                self._temp_refs.append(ref)
+                temps.append(ref)
         return buckets, schema
 
-    def _compile_repartition(self, node: P.Repartition):
+    def _compile_repartition(self, node: P.Repartition, temps: List[ObjectRef]):
         n = node.num_partitions
         if not node.shuffle:
             # coalesce: group existing partitions without moving rows by key
-            refs, schema, _ = self._materialize_inner(node.child)
-            self._temp_refs.extend(refs)
+            refs, schema, _ = self._materialize_inner(node.child, None, temps)
+            temps.extend(refs)
             groups = np.array_split(np.arange(len(refs)), n)
             tasks = [self._task(T.ArrowRefSource([refs[i] for i in g], schema=schema))
                      for g in groups if len(g) > 0]
             return tasks, [None] * len(tasks)
-        buckets, schema = self._shuffle_children(node.child, n, keys=None)
+        buckets, schema = self._shuffle_children(node.child, n, keys=None, temps=temps)
         tasks = [self._task(T.ArrowRefSource(bucket, schema=schema))
                  for bucket in buckets]
         return tasks, [None] * len(tasks)
 
-    def _compile_groupagg(self, node: P.GroupAgg):
+    def _compile_groupagg(self, node: P.GroupAgg, temps: List[ObjectRef]):
         nb = min(self.shuffle_partitions, max(1, len(self.pool.executors) * 2))
-        buckets, schema = self._shuffle_children(node.child, nb, keys=node.keys)
+        buckets, schema = self._shuffle_children(node.child, nb, keys=node.keys,
+                                                 temps=temps)
         tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
                             [T.GroupAggStep(node.keys, node.aggs)])
                  for bucket in buckets]
         return tasks, [None] * len(tasks)
 
-    def _compile_join(self, node: P.Join):
+    def _compile_join(self, node: P.Join, temps: List[ObjectRef]):
         nb = min(self.shuffle_partitions, max(1, len(self.pool.executors) * 2))
-        left_buckets, lschema = self._shuffle_children(node.left, nb, node.keys)
+        left_buckets, lschema = self._shuffle_children(node.left, nb, node.keys,
+                                                       temps)
         right_buckets, rschema = self._shuffle_children(node.right, nb,
-                                                        node.right_keys)
+                                                        node.right_keys, temps)
         tasks = []
         for lb, rb in zip(left_buckets, right_buckets):
             tasks.append(self._task(
@@ -414,23 +423,33 @@ class Engine:
                                 right_schema=rschema)]))
         return tasks, [None] * len(tasks)
 
-    def _compile_sort(self, node: P.Sort):
+    def _compile_sort(self, node: P.Sort, temps: List[ObjectRef]):
         """Range-partitioned sort: materialize the child ONCE, sample boundary
-        values from a few blocks (any orderable type — no numeric cast), range-
-        shuffle those refs, locally sort each range."""
+        values from EVERY block on the executors (any orderable type — no
+        numeric cast), range-shuffle those refs, locally sort each range."""
         key, order = node.keys[0]
-        refs, schema, num_rows = self._materialize_inner(node.child)
-        self._temp_refs.extend(refs)
-        client = get_client()
+        refs, schema, num_rows = self._materialize_inner(node.child, None, temps)
+        temps.extend(refs)
 
-        # boundary sample: up to 4 non-empty blocks read driver-side
-        sampled = []
-        for ref, n in zip(refs, num_rows):
-            if n > 0:
-                sampled.append(client.get(ref).column(key))
-            if len(sampled) >= 4:
-                break
+        # boundary sample: a bounded uniform sample over ALL blocks, taken by
+        # the executors — sampling only the first blocks skews the range
+        # boundaries on sorted or clustered input
         nb = min(self.shuffle_partitions, max(1, len(self.pool.executors) * 2))
+        total = sum(num_rows)
+        target = max(1000, 100 * nb)
+        frac = min(1.0, target / total) if total else 0.0
+        sample_tasks = [
+            self._task(T.ArrowRefSource([ref], schema=schema),
+                       [T.SampleStep(frac, seed=0, partition_index=i)]
+                       ).with_output(output=T.COLLECT)
+            for i, (ref, n) in enumerate(zip(refs, num_rows)) if n > 0
+        ]
+        sampled = []
+        if sample_tasks:
+            for r in self.pool.run_tasks(sample_tasks):
+                tbl = pa.ipc.open_stream(pa.py_buffer(r["ipc"])).read_all()
+                if tbl.num_rows:
+                    sampled.append(tbl.column(key))
         if not sampled:
             boundaries: List = []
         else:
@@ -455,7 +474,7 @@ class Engine:
         for r in results:
             for b, ref in enumerate(r["bucket_refs"]):
                 buckets[b].append(ref)
-                self._temp_refs.append(ref)
+                temps.append(ref)
         if order == "descending":
             buckets = buckets[::-1]
         tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),

@@ -229,6 +229,15 @@ class RpcClient:
     def call(self, method: str, *args, timeout: Optional[float] = None, **kwargs) -> Any:
         return self.submit(method, *args, **kwargs).result(timeout=timeout)
 
+    @property
+    def local_host(self) -> str:
+        """This process's address on the route to the server — the right host
+        for services that peers across the same network must reach."""
+        try:
+            return self._sock.getsockname()[0]
+        except OSError:
+            return "127.0.0.1"
+
     def close(self) -> None:
         self._closed = True
         try:

@@ -72,6 +72,12 @@ class _DriverService:
     def register_worker_service(self, rank: int, host: str, port: int) -> bool:
         return self._job._on_register_service(rank, host, port)
 
+    def set_coordinator(self, address: str) -> bool:
+        return self._job._on_set_coordinator(address)
+
+    def get_coordinator(self, timeout: float = 120.0) -> str:
+        return self._job._wait_coordinator(timeout)
+
     def ping(self) -> str:
         return "pong"
 
@@ -111,6 +117,7 @@ class SPMDJob:
         self._func_id = 0
         self._started = False
         self._placement_group_id: Optional[str] = None
+        self._coordinator: Optional[str] = None
 
     # -- registration callbacks (driver service) ------------------------------
     def _on_register_worker(self, rank: int, pid: int) -> Dict[str, Any]:
@@ -124,6 +131,29 @@ class SPMDJob:
             self._services[rank] = (host, port)
             self._barrier.notify_all()
         return True
+
+    def _on_set_coordinator(self, address: str) -> bool:
+        """Rank 0 picks the JAX coordinator port on its own interface moments
+        before ``jax.distributed`` binds it and reports it here — a far
+        smaller reuse window than a driver-side pick that sits unclaimed
+        through the whole gang spawn (and a gang restart retries it). The
+        host is rank 0's routable address, so the gang is not limited to one
+        machine."""
+        with self._barrier:
+            self._coordinator = address
+            self._barrier.notify_all()
+        return True
+
+    def _wait_coordinator(self, timeout: float) -> str:
+        deadline = time.time() + timeout
+        with self._barrier:
+            while self._coordinator is None:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError("coordinator address never arrived "
+                                       "(rank 0 dead before jax.distributed?)")
+                self._barrier.wait(timeout=min(1.0, remaining))
+            return self._coordinator
 
     def _wait_barrier(self, table: dict, phase: str) -> None:
         deadline = time.time() + self.timeout
@@ -153,9 +183,8 @@ class SPMDJob:
         self._server = RpcServer(MethodDispatcher(_DriverService(self)),
                                  max_concurrency=max(4, self.world_size),
                                  name=f"spmd-{self.job_name}")
-        coordinator = f"127.0.0.1:{_free_port()}" if self.jax_distributed else ""
         for rank in range(self.world_size):
-            self._procs.append(self._spawn_rank(rank, coordinator))
+            self._procs.append(self._spawn_rank(rank))
         # two-phase barrier (parity: mpi_job.py:280-318)
         self._wait_barrier(self._registered, "register")
         self._wait_barrier(self._services, "service")
@@ -193,7 +222,7 @@ class SPMDJob:
         os.makedirs(base, exist_ok=True)
         return os.path.join(base, f"spmd-{self.job_name}-rank{rank}.out")
 
-    def _spawn_rank(self, rank: int, coordinator: str) -> subprocess.Popen:
+    def _spawn_rank(self, rank: int) -> subprocess.Popen:
         env = dict(os.environ)
         env.update(self.extra_env)
         from raydp_tpu.runtime import head as head_mod
@@ -209,8 +238,6 @@ class SPMDJob:
         env[ENV_RANK] = str(rank)
         env[ENV_WORLD] = str(self.world_size)
         env[ENV_JAX_DIST] = "1" if self.jax_distributed else "0"
-        if coordinator:
-            env[ENV_COORDINATOR] = coordinator
         driver_path = [p for p in sys.path if p]
         if env.get("PYTHONPATH"):
             driver_path.append(env["PYTHONPATH"])
@@ -230,13 +257,20 @@ class SPMDJob:
         rank (parity: mpi_job.py:324-338)."""
         if not self._started:
             raise RuntimeError(f"SPMD job {self.job_name} not started")
+        import concurrent.futures as cf
+
         self._func_id += 1
         payload = cloudpickle.dumps(fn)
-        futures = {rank: stub.submit("run_function", self._func_id, payload)
-                   for rank, stub in self._stubs.items()}
+        fut_to_rank = {
+            stub.submit("run_function", self._func_id, payload): rank
+            for rank, stub in self._stubs.items()
+        }
         results: List[Any] = [None] * self.world_size
-        for rank, fut in futures.items():
-            ok, value = fut.result(timeout=timeout or self.timeout)
+        # fail fast: a dead rank surfaces the moment its connection drops,
+        # without waiting out ranks that are hung in a collective behind it
+        for fut in cf.as_completed(fut_to_rank, timeout=timeout or self.timeout):
+            rank = fut_to_rank[fut]
+            ok, value = fut.result()
             if not ok:
                 raise RuntimeError(
                     f"SPMD job {self.job_name} rank {rank} failed:\n{value}")
@@ -289,6 +323,7 @@ class SPMDJob:
         self._procs.clear()
         self._registered.clear()
         self._services.clear()
+        self._coordinator = None
         self._func_id = 0
         self._started = False
         logger.info("SPMD job %s stopped", self.job_name)
@@ -311,9 +346,9 @@ def create_spmd_job(
                    cpus_per_process=cpus_per_process, timeout=timeout)
 
 
-def _free_port() -> int:
+def _free_port(host: str = "127.0.0.1") -> int:
     s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    s.bind(("127.0.0.1", 0))
+    s.bind((host, 0))
     port = s.getsockname()[1]
     s.close()
     return port

@@ -27,7 +27,7 @@ from raydp_tpu.log import init_logging
 from raydp_tpu.runtime.rpc import RpcServer, connect_with_retry
 from raydp_tpu.spmd.job import (
     ENV_COORDINATOR, ENV_DRIVER, ENV_JAX_DIST, ENV_JOB_ID, ENV_RANK, ENV_WORLD,
-    WorkerContext,
+    WorkerContext, _free_port,
 )
 
 
@@ -70,6 +70,13 @@ def _delayed_exit():
 
 
 def main() -> None:
+    import faulthandler
+    import signal
+
+    # SIGUSR1 → dump all thread stacks to stderr (lands in the rank .out
+    # file), so a hung collective can be diagnosed from outside
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
     job_id = os.environ[ENV_JOB_ID]
     driver_url = os.environ[ENV_DRIVER]
     rank = int(os.environ[ENV_RANK])
@@ -78,6 +85,11 @@ def main() -> None:
     init_logging(f"spmd-{job_id}-r{rank}", os.environ.get("RDT_LOG_LEVEL", "INFO"),
                  None, job_id)
 
+    d_host, d_port = driver_url.rsplit(":", 1)
+    driver = connect_with_retry((d_host, int(d_port)))
+    reply = driver.call("register_worker", rank, os.getpid())
+    assert reply["world_size"] == world_size
+
     if os.environ.get(ENV_JAX_DIST) == "1":
         import jax
         # interpreter startup may have pre-registered a hardware platform;
@@ -85,9 +97,25 @@ def main() -> None:
         # the first device touch (same dance as tests/conftest.py)
         if os.environ.get("JAX_PLATFORMS"):
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        jax.distributed.initialize(
-            coordinator_address=os.environ[ENV_COORDINATOR],
-            num_processes=world_size, process_id=rank)
+        coordinator = os.environ.get(ENV_COORDINATOR)  # test/ops override
+        if not coordinator:
+            if rank == 0:
+                # rank 0 picks the port on its own routable interface moments
+                # before jax binds it (narrows the reuse race to this process's
+                # own window — a driver-side pick could sit unclaimed through
+                # the whole gang spawn) and reports it to the other ranks via
+                # the driver; the host is this process's address toward the
+                # driver, reachable from peers on other machines
+                host = driver.local_host
+                coordinator = f"{host}:{_free_port(host)}"
+                driver.call("set_coordinator", coordinator)
+            else:
+                # first arg is the server-side wait; the kwarg is the client
+                # deadline (RpcClient.call consumes `timeout=` itself)
+                coordinator = driver.call("get_coordinator", 120.0,
+                                          timeout=130.0)
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=world_size, process_id=rank)
 
     # join the data plane if a runtime session is live (parity: ray.init in
     # every MPI rank, mpi_worker.py:159-160)
@@ -114,12 +142,7 @@ def main() -> None:
 
     ctx = WorkerContext(job_id=job_id, rank=rank, world_size=world_size)
 
-    d_host, d_port = driver_url.rsplit(":", 1)
-    driver = connect_with_retry((d_host, int(d_port)))
-    reply = driver.call("register_worker", rank, os.getpid())
-    assert reply["world_size"] == world_size
-
-    server = RpcServer(_WorkerService(ctx), host="127.0.0.1", port=0,
+    server = RpcServer(_WorkerService(ctx), host=driver.local_host, port=0,
                        max_concurrency=2, name=f"spmd-r{rank}")
     driver.call("register_worker_service", rank, server.address[0],
                 server.address[1])

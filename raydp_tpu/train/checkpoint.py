@@ -33,19 +33,52 @@ def _step_dirs(ckpt_dir: str):
     return sorted(out)
 
 
-def save(ckpt_dir: str, state: Any, step: int) -> Optional[str]:
+def _checkpointer():
+    """An orbax checkpointer whose barriers never leave this process.
+
+    Under a multi-process gang only the chief saves (and every rank restores
+    independently from shared storage); stock orbax would run a
+    ``sync_global_devices`` barrier across ALL processes inside save() —
+    called from one rank, that deadlocks the gang (observed as a Gloo clique
+    of one device per process timing out). ``active_processes={self}`` scopes
+    every barrier to the calling process.
+    """
+    import jax
+    import orbax.checkpoint as ocp
+
+    if jax.process_count() > 1:
+        from orbax.checkpoint.options import MultiprocessingOptions
+        me = jax.process_index()
+        return ocp.Checkpointer(
+            ocp.PyTreeCheckpointHandler(),
+            multiprocessing_options=MultiprocessingOptions(
+                primary_host=me, active_processes={me},
+                barrier_sync_key_prefix=f"proc{me}"))
+    return ocp.PyTreeCheckpointer()
+
+
+def save(ckpt_dir: str, state: Any, step: int,
+         extra: Optional[dict] = None) -> Optional[str]:
+    """Chief-only checkpoint write. ``extra`` is a JSON-serializable sidecar
+    (e.g. the accumulated epoch history, so a restarted gang's result is not
+    truncated to post-restart epochs)."""
     import jax
 
     if jax.process_index() != 0:
         return None
-    import orbax.checkpoint as ocp
 
     os.makedirs(ckpt_dir, exist_ok=True)
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step}")
     if os.path.exists(path):
         shutil.rmtree(path)
-    with ocp.PyTreeCheckpointer() as ckptr:
+    with _checkpointer() as ckptr:
         ckptr.save(path, jax.device_get(state))
+    if extra is not None:
+        import json
+        tmp = os.path.join(ckpt_dir, f".extra_{step}.tmp")
+        with open(tmp, "w") as f:
+            json.dump(extra, f)
+        os.replace(tmp, os.path.join(path, "extra.json"))
     # retention: keep the newest _KEEP
     steps = _step_dirs(ckpt_dir)
     for _, old in steps[:-_KEEP]:
@@ -59,12 +92,25 @@ def restore(ckpt_dir: str, template: Any) -> Optional[Tuple[Any, int]]:
     Returns ``(state, step)`` or None if no checkpoint exists.
     """
     import jax
-    import orbax.checkpoint as ocp
 
     steps = _step_dirs(ckpt_dir)
     if not steps:
         return None
     step, path = steps[-1]
-    with ocp.PyTreeCheckpointer() as ckptr:
+    with _checkpointer() as ckptr:
         restored = ckptr.restore(path, item=jax.device_get(template))
     return restored, step
+
+
+def restore_extra(ckpt_dir: str) -> Optional[dict]:
+    """The JSON sidecar of the latest checkpoint, or None."""
+    import json
+
+    steps = _step_dirs(ckpt_dir)
+    if not steps:
+        return None
+    path = os.path.join(steps[-1][1], "extra.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)

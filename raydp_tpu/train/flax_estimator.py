@@ -174,22 +174,10 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
     # -------------------------------------------------------------------- fit
     def fit(self, train_ds, evaluate_ds=None, max_retries: int = 0
             ) -> TrainingResult:
-        import jax
-        import jax.numpy as jnp
-        import optax
-        from flax.training import train_state
-
         from raydp_tpu.data.feed import DeviceFeed
-        from raydp_tpu.parallel import batch_sharding, param_sharding_rules
-        from raydp_tpu.train import checkpoint as ckpt
 
         mesh = self._build_mesh()
-        model = self._build_model()
-        tx = self._build_optimizer()
-        loss_fn = _resolve_loss(self._loss)
-        metrics = self._metrics
         columns = self._columns()
-
         ckpt_dir = self.checkpoint_dir or tempfile.mkdtemp(prefix="rdt-ckpt-")
 
         feed = DeviceFeed(train_ds, self.batch_size, columns, mesh=mesh,
@@ -204,6 +192,50 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
             eval_feed = DeviceFeed(evaluate_ds, self.batch_size, columns,
                                    mesh=mesh, shuffle=False,
                                    drop_remainder=dp_total > 1)
+
+        state, history = self._train_loop(mesh, feed, eval_feed, ckpt_dir,
+                                          max_retries=max_retries)
+        self._result = TrainingResult(state=state, history=history,
+                                      checkpoint_dir=ckpt_dir)
+        return self._result
+
+    @staticmethod
+    def _place_state(tree, shardings):
+        """Place a (host or local-device) pytree under global shardings.
+
+        Single-process: plain sharded ``device_put``. Multi-process (gang
+        mode): ``make_array_from_callback`` — every process holds the full
+        host value (same rng / same checkpoint), each device reads its shard.
+        """
+        import jax
+
+        if jax.process_count() > 1:
+            def _put(x, s):
+                if x is None:
+                    return None
+                host = np.asarray(x)
+                return jax.make_array_from_callback(
+                    host.shape, s, lambda idx: host[idx])
+        else:
+            def _put(x, s):
+                return None if x is None else jax.device_put(x, s)
+        return jax.tree.map(_put, tree, shardings,
+                            is_leaf=lambda x: x is None)
+
+    def _train_loop(self, mesh, feed, eval_feed, ckpt_dir: str,
+                    max_retries: int = 0, resume: bool = False):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from flax.training import train_state
+
+        from raydp_tpu.parallel import batch_sharding, param_sharding_rules
+        from raydp_tpu.train import checkpoint as ckpt
+
+        model = self._build_model()
+        tx = self._build_optimizer()
+        loss_fn = _resolve_loss(self._loss)
+        metrics = self._metrics
 
         # ---- init params from one host batch's shapes ----
         import inspect
@@ -232,9 +264,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
         shardings_of = param_sharding_rules(mesh, self.param_rules)
         state_sharding = shardings_of(state)
-        state = jax.tree.map(
-            lambda x, s: jax.device_put(x, s), state, state_sharding,
-            is_leaf=lambda x: x is None)
+        state = self._place_state(state, state_sharding)
         b_sharding = batch_sharding(mesh)
 
         compute_dtype = self.compute_dtype
@@ -268,7 +298,14 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 preds = preds.squeeze(-1)
             return preds.astype(jnp.float32), labels, new_bstats
 
-        def train_step(state, batch, mstats):
+        # Loss accumulators are threaded THROUGH the jitted steps rather than
+        # collected as a host-side list: under a multi-process gang, an eager
+        # op over global arrays (e.g. jnp.stack of per-step losses) is a
+        # cross-process computation that every process must dispatch in the
+        # same order — a rank that is one step behind deadlocks the gang. With
+        # in-jit accumulation the only host reads are float() of replicated
+        # scalars at epoch end (also one fewer host sync single-process).
+        def train_step(state, batch, mstats, loss_sum):
             def _loss(params):
                 preds, labels, new_bstats = _apply(
                     params, state.batch_stats, batch, train=True)
@@ -282,22 +319,33 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
             _, labels = split_batch(batch)
             new_mstats = tuple(
                 m.update(s, preds, labels) for m, s in zip(metrics, mstats))
-            return new_state, loss_val, new_mstats
+            return new_state, loss_sum + loss_val.astype(jnp.float32), new_mstats
 
-        def eval_step(state, batch, mstats):
+        def eval_step(state, batch, mstats, loss_sum, count):
             preds, labels, _ = _apply(state.params, state.batch_stats, batch,
                                       train=False)
-            loss_val = loss_fn(preds, labels)
+            loss_val = loss_fn(preds, labels).astype(jnp.float32)
+            n = labels.shape[0]
             new_mstats = tuple(
                 m.update(s, preds, labels) for m, s in zip(metrics, mstats))
-            return loss_val, labels.shape[0], new_mstats
+            return loss_sum + loss_val * n, count + n, new_mstats
 
-        jit_train = jax.jit(train_step, donate_argnums=(0,))
-        jit_eval = jax.jit(eval_step)
+        jit_train = jax.jit(train_step, donate_argnums=(0, 3))
+        jit_eval = jax.jit(eval_step, donate_argnums=(3, 4))
 
         history: List[Dict[str, float]] = []
         epoch = 0
         retries = 0
+        if resume:
+            restored = ckpt.restore(ckpt_dir, state)
+            if restored is not None:
+                host_state, done_epoch = restored
+                state = self._place_state(host_state, state_sharding)
+                epoch = done_epoch + 1
+                extra = ckpt.restore_extra(ckpt_dir)
+                if extra and "history" in extra:
+                    history = list(extra["history"])
+                logger.info("resuming from checkpoint step %d", done_epoch)
         from raydp_tpu import profiler
 
         while epoch < self.num_epochs:
@@ -305,16 +353,17 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 t0 = time.perf_counter()
                 feed.set_epoch(epoch)
                 mstats = tuple(m.init() for m in metrics)
-                losses, steps, samples = [], 0, 0
+                loss_sum = np.zeros((), np.float32)
+                steps, samples = 0, 0
                 for batch in feed:
-                    state, loss_val, mstats = jit_train(state, batch, mstats)
-                    losses.append(loss_val)
+                    state, loss_sum, mstats = jit_train(state, batch, mstats,
+                                                        loss_sum)
                     steps += 1
                     samples += self.batch_size
                 dt = time.perf_counter() - t0
                 report = {
                     "epoch": epoch,
-                    "train_loss": float(jnp.mean(jnp.stack(losses))) if losses
+                    "train_loss": float(loss_sum) / steps if steps
                     else float("nan"),
                     "steps": steps,
                     "samples_per_s": samples / dt if dt > 0 else 0.0,
@@ -326,13 +375,19 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
                 if eval_feed is not None:
                     estats = tuple(m.init() for m in metrics)
-                    elosses, ecount = [], 0
+                    esum = np.zeros((), np.float32)
+                    ecnt = np.zeros((), np.float32)
+                    esteps = 0
                     for batch in eval_feed:
-                        l, n, estats = jit_eval(state, batch, estats)
-                        elosses.append(float(l) * int(n))
-                        ecount += int(n)
-                    report["eval_loss"] = (sum(elosses) / ecount) if ecount else \
-                        float("nan")
+                        esum, ecnt, estats = jit_eval(state, batch, estats,
+                                                      esum, ecnt)
+                        esteps += 1
+                    if esteps:
+                        total = float(ecnt)
+                        report["eval_loss"] = (float(esum) / total) if total \
+                            else float("nan")
+                    else:
+                        report["eval_loss"] = float("nan")
                     for m, s in zip(metrics, estats):
                         report[f"eval_{m.name}"] = m.compute(
                             jax.tree.map(np.asarray, s))
@@ -343,7 +398,8 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 logger.info("epoch %d: %s", epoch,
                             {k: (round(v, 5) if isinstance(v, float) else v)
                              for k, v in report.items()})
-                ckpt.save(ckpt_dir, state, step=epoch)
+                ckpt.save(ckpt_dir, state, step=epoch,
+                          extra={"history": history})
                 epoch += 1
             except (KeyboardInterrupt, SystemExit):
                 raise
@@ -355,18 +411,138 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                                "(retry %d/%d)", epoch, e, retries, max_retries)
                 restored = ckpt.restore(ckpt_dir, state)
                 if restored is not None:
-                    state, epoch = restored
-                    epoch += 1
+                    host_state, done_epoch = restored
+                    state = self._place_state(host_state, state_sharding)
+                    epoch = done_epoch + 1
+                    extra = ckpt.restore_extra(ckpt_dir)
+                    if extra and "history" in extra:
+                        history = list(extra["history"])
 
-        self._result = TrainingResult(state=state, history=history,
+        return state, history
+
+    # --------------------------------------------------------------- fit_gang
+    def fit_gang(self, train_ds, evaluate_ds=None, *, num_workers: int = 2,
+                 max_retries: int = 0, job_name: Optional[str] = None,
+                 run_timeout: float = 3600.0,
+                 start_timeout: float = 180.0) -> TrainingResult:
+        """Train as a gang of ``num_workers`` processes under one global
+        ``jax.distributed`` mesh.
+
+        Parity: ``TorchTrainer`` + ``ScalingConfig(num_workers)`` +
+        ``RunConfig(FailureConfig(max_failures))`` (reference
+        torch/estimator.py:312-356). Each rank rebuilds the dataset from the
+        object store, feeds its slice of every global batch
+        (:class:`GangShardIterator` → ``make_array_from_process_local_data``),
+        and runs the same jitted train loop; XLA inserts the gradient
+        collectives over the global mesh. Rank 0 writes orbax checkpoints.
+        A dead or failing rank fails the whole gang (XLA collectives are not
+        elastic mid-program, SURVEY.md §7 hard part (c)); the driver then
+        restarts the gang, which resumes from the last checkpoint — up to
+        ``max_retries`` restarts.
+        """
+        import copy
+        import uuid as _uuid
+
+        from raydp_tpu.spmd.job import create_spmd_job
+
+        if self._mesh is not None:
+            raise ValueError("fit_gang builds its mesh inside the ranks; "
+                             "pass mesh_spec instead of a driver-built mesh")
+        if self.param_rules is not None or (
+                self._mesh_spec is not None and any(
+                    getattr(self._mesh_spec, a) != 1
+                    for a in ("fsdp", "expert", "seq", "tensor"))):
+            # chief-only orbax save + device_get(state) require every process
+            # to hold full replicas; cross-process param sharding needs a
+            # multihost checkpoint path (not wired up yet) — fail clearly
+            raise NotImplementedError(
+                "fit_gang currently supports replicated parameters (pure DP); "
+                "drop param_rules / non-data mesh axes")
+        ckpt_dir = self.checkpoint_dir or tempfile.mkdtemp(prefix="rdt-gang-")
+        train_payload = train_ds.portable()
+        eval_payload = evaluate_ds.portable() if evaluate_ds is not None else None
+
+        est = copy.copy(self)
+        est._result = None
+        est.checkpoint_dir = ckpt_dir
+
+        def _rank_fit(ctx):
+            return est._gang_rank_fit(ctx, train_payload, eval_payload,
+                                      ckpt_dir)
+
+        job = create_spmd_job(job_name or f"flaxfit-{_uuid.uuid4().hex[:6]}",
+                              num_workers, jax_distributed=True,
+                              timeout=start_timeout)
+        attempts = 0
+        while True:
+            job.start()
+            try:
+                results = job.run(_rank_fit, timeout=run_timeout)
+                job.stop()
+                break
+            except (KeyboardInterrupt, SystemExit):
+                job.stop()
+                raise
+            except Exception as e:  # noqa: BLE001 - gang restart (FailureConfig)
+                job.stop()
+                attempts += 1
+                if attempts > max_retries:
+                    raise
+                logger.warning("gang fit failed (%s); restarting gang from "
+                               "last checkpoint (retry %d/%d)",
+                               e, attempts, max_retries)
+
+        chief = results[0]
+        from types import SimpleNamespace
+        state = SimpleNamespace(
+            params=chief["model_vars"]["params"],
+            batch_stats=chief["model_vars"].get("batch_stats"))
+        self._result = TrainingResult(state=state, history=chief["history"],
                                       checkpoint_dir=ckpt_dir)
         return self._result
+
+    def _gang_rank_fit(self, ctx, train_payload, eval_payload, ckpt_dir: str):
+        """Runs inside each SPMD rank (the reference's ``train_func`` body,
+        torch/estimator.py:177-310)."""
+        import jax
+
+        from raydp_tpu.data.dataset import DistributedDataset
+        from raydp_tpu.data.feed import DeviceFeed, GangShardIterator
+
+        columns = self._columns()
+        mesh = self._build_mesh()  # jax.devices() is global under the gang
+        train_ds = DistributedDataset.from_portable(train_payload)
+        feed = DeviceFeed(
+            train_ds, self.batch_size, columns, mesh=mesh,
+            host_iter=GangShardIterator(
+                train_ds, self.batch_size, ctx.world_size, ctx.rank, columns,
+                shuffle=self.shuffle, seed=self.seed))
+        eval_feed = None
+        if eval_payload is not None:
+            eval_ds = DistributedDataset.from_portable(eval_payload)
+            eval_feed = DeviceFeed(
+                eval_ds, self.batch_size, columns, mesh=mesh,
+                host_iter=GangShardIterator(
+                    eval_ds, self.batch_size, ctx.world_size, ctx.rank,
+                    columns, shuffle=False, seed=self.seed))
+
+        state, history = self._train_loop(mesh, feed, eval_feed, ckpt_dir,
+                                          max_retries=0, resume=True)
+        out = {"history": history}
+        if ctx.rank == 0:
+            model_vars = {"params": jax.device_get(state.params)}
+            bstats = getattr(state, "batch_stats", None)
+            if bstats is not None:
+                model_vars["batch_stats"] = jax.device_get(bstats)
+            out["model_vars"] = model_vars
+        return out
 
     # ----------------------------------------------------------- fit_on_frame
     def fit_on_frame(self, train_df, evaluate_df=None, *,
                      fs_directory: Optional[str] = None,
                      stop_etl_after_conversion: bool = False,
-                     max_retries: int = 0) -> TrainingResult:
+                     max_retries: int = 0,
+                     num_workers: Optional[int] = None) -> TrainingResult:
         train_ds, eval_ds = self._convert_frames(
             train_df, evaluate_df, fs_directory=fs_directory,
             stop_etl_after_conversion=stop_etl_after_conversion)
@@ -374,6 +550,9 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         if self.shuffle:
             # parity: random_shuffle before training (torch/estimator.py:335-338)
             train_ds = train_ds.random_shuffle(seed=self.seed)
+        if num_workers is not None and num_workers > 1:
+            return self.fit_gang(train_ds, eval_ds, num_workers=num_workers,
+                                 max_retries=max_retries)
         return self.fit(train_ds, eval_ds, max_retries=max_retries)
 
     # -------------------------------------------------------------- get_model

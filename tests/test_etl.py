@@ -247,3 +247,59 @@ def test_modulo_semantics(session):
     assert m[big] == big % 1000
     import math
     assert rows["m"].isna().iloc[3] or math.isnan(rows["m"].iloc[3])  # div by 0 -> null
+
+
+def test_sort_sorted_input_balanced_ranges(session):
+    # regression (sort sampling skew): already-sorted input used to have its
+    # boundaries sampled from the first blocks only, collapsing every row
+    # into one range partition
+    df = session.createDataFrame(
+        pd.DataFrame({"x": np.arange(2000)}), num_partitions=4)
+    out = df.sort("x").to_pandas()
+    assert list(out["x"]) == list(range(2000))
+
+
+def test_concurrent_actions(session, people):
+    # two shuffling actions racing on one session must not cross-free each
+    # other's shuffle intermediates (Engine tracks temps per action)
+    import threading
+
+    errors = []
+    results = {}
+
+    def _agg(tag):
+        try:
+            out = people.groupBy("city").agg(
+                F.count("age").alias("n")).to_pandas().set_index("city")
+            results[tag] = int(out.loc["nyc", "n"])
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=_agg, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert all(v == 3 for v in results.values())
+
+
+def test_dynamic_allocation_shrink_grow(session):
+    from raydp_tpu.data.dataset import from_frame_recoverable
+
+    pdf = pd.DataFrame({"x": np.arange(4000), "y": np.arange(4000) % 7})
+    df = session.createDataFrame(pdf, num_partitions=4)
+    ds = from_frame_recoverable(df, fetch=False)  # cached across 2 executors
+
+    # shrink: the killed executor's cached blocks must recover via lineage
+    # on the survivor (parity: RayCoarseGrainedSchedulerBackend.scala:278-301)
+    assert session.request_total_executors(1) == 1
+    total = sum(ds.get_block(i).num_rows for i in range(ds.num_blocks()))
+    assert total == 4000
+
+    # grow back up; new executors serve fresh work
+    assert session.request_total_executors(3) == 3
+    df2 = session.createDataFrame(pdf, num_partitions=6)
+    assert df2.count() == 4000
+    out = df2.groupBy("y").agg(F.count("x").alias("n")).to_pandas()
+    assert int(out["n"].sum()) == 4000

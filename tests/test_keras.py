@@ -78,9 +78,10 @@ def test_data_parallel_over_virtual_mesh(session):
 
     assert len(jax.devices()) == 8
     df = _make_frame(session)
-    est = _estimator(num_epochs=2, data_parallel=True)
+    est = _estimator(num_epochs=4, data_parallel=True)
     result = est.fit_on_frame(df)
-    assert result.history[-1]["loss"] < result.history[0]["loss"] * 2
+    # the model must actually learn, not merely not diverge
+    assert result.history[-1]["loss"] < result.history[0]["loss"]
 
     saved = os.path.join(result.checkpoint_dir, "model.keras")
     assert os.path.exists(saved)
