@@ -45,11 +45,53 @@ ENV_SESSION = "RAYDP_TPU_SESSION"
 ENV_SESSION_DIR = "RAYDP_TPU_SESSION_DIR"
 
 
+class _RemoteProcess:
+    """Popen-shaped handle to a process spawned by a node agent.
+
+    ``poll`` is throttled (one RPC per second per actor) so the supervisor's
+    tight loop stays cheap; a lost agent connection reads as exit code -1 with
+    ``lost`` set, which the supervisor escalates to node death.
+    """
+
+    _POLL_INTERVAL = 1.0
+
+    def __init__(self, agent, pid: int, node_id: str):
+        self._agent = agent
+        self.pid = pid
+        self.node_id = node_id
+        self.lost = False
+        self._last_poll = 0.0
+        self._last_code: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self.lost or self._last_code is not None:
+            return self._last_code if self._last_code is not None else -1
+        now = time.monotonic()
+        if now - self._last_poll < self._POLL_INTERVAL:
+            return None
+        self._last_poll = now
+        try:
+            code = self._agent.call("poll", self.pid, timeout=10.0)
+        except Exception:
+            self.lost = True
+            self._last_code = -1
+            return -1
+        if code is not None:
+            self._last_code = int(code)
+        return self._last_code
+
+    def kill(self) -> None:
+        try:
+            self._agent.call("kill", self.pid, timeout=10.0)
+        except Exception:
+            self.lost = True
+
+
 @dataclass
 class ActorRecord:
     spec: ActorSpec
     state: str = PENDING
-    process: Optional[subprocess.Popen] = None
+    process: Optional[Any] = None  # subprocess.Popen or _RemoteProcess
     address: Optional[tuple] = None
     node_id: Optional[str] = None
     restart_count: int = 0
@@ -171,6 +213,14 @@ class HeadService:
     def remove_node(self, node_id: str) -> None:
         self._rt.remove_node(node_id)
 
+    def register_node_agent(self, host: str, port: int,
+                            resources: Dict[str, float],
+                            address: str) -> Dict[str, Any]:
+        """A node agent joins: its machine becomes a schedulable node whose
+        actor processes the head spawns through the agent (parity: a Ray
+        raylet registering with the GCS, SURVEY.md §1 L1)."""
+        return self._rt.register_node_agent(host, port, resources, address)
+
     def create_placement_group(self, bundles: List[Dict[str, float]],
                                strategy: str) -> Dict[str, Any]:
         group = self._rt.resource_manager.create_group(
@@ -189,6 +239,21 @@ class HeadService:
 
     def ping(self) -> str:
         return "pong"
+
+
+def _terminate(proc) -> None:
+    """Kill a local Popen (whole process group) or a remote agent process."""
+    if isinstance(proc, _RemoteProcess):
+        proc.kill()
+        return
+    if proc.poll() is None:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
 
 
 def _group_to_dict(group: PlacementGroup) -> Dict[str, Any]:
@@ -226,6 +291,7 @@ class RuntimeContext:
 
         self.records: Dict[str, ActorRecord] = {}
         self.names: Dict[str, str] = {}
+        self.node_agents: Dict[str, Any] = {}  # node_id → agent RpcClient
         self._lock = threading.RLock()
         self._stopped = threading.Event()
 
@@ -341,29 +407,49 @@ class RuntimeContext:
         return handle
 
     def _spawn(self, rec: ActorRecord) -> None:
-        env = dict(os.environ)
-        env.update(rec.spec.env)
-        env[ENV_HEAD] = self.server.url
-        env[ENV_ACTOR_ID] = rec.spec.actor_id
-        env[ENV_SESSION] = self.session_id
-        env[ENV_SESSION_DIR] = self.session_dir
-        # child must resolve every module the driver can (cloudpickle pickles
-        # classes by reference): prepend the driver's sys.path
-        driver_path = [p for p in sys.path if p]
-        existing = env.get("PYTHONPATH")
-        if existing:
-            driver_path.append(existing)
-        env["PYTHONPATH"] = os.pathsep.join(driver_path)
-        log_path = os.path.join(
-            self.session_dir, "logs",
-            f"{rec.spec.name or rec.spec.actor_id}-r{rec.restart_count}.out")
-        out = open(log_path, "ab")
-        rec.process = subprocess.Popen(
-            [sys.executable, "-m", "raydp_tpu.runtime.actor_main"],
-            env=env, stdout=out, stderr=subprocess.STDOUT,
-            start_new_session=True,
-        )
-        out.close()
+        log_name = (f"{rec.spec.name or rec.spec.actor_id}"
+                    f"-r{rec.restart_count}")
+        agent = self.node_agents.get(rec.node_id) if rec.node_id else None
+        if agent is not None:
+            # the node is served by an agent: spawn there (real multi-node
+            # placement — node affinity resolves to that machine's processes)
+            overrides = dict(rec.spec.env)
+            overrides[ENV_HEAD] = self.server.url
+            overrides[ENV_ACTOR_ID] = rec.spec.actor_id
+            overrides[ENV_SESSION] = self.session_id
+            overrides[ENV_SESSION_DIR] = self.session_dir
+            # forward the driver's import path: cloudpickle pickles classes
+            # by reference, so the child must resolve the driver's modules
+            # (the agent appends its own path after these)
+            driver_path = [p for p in sys.path if p]
+            if overrides.get("PYTHONPATH"):
+                driver_path.append(overrides["PYTHONPATH"])
+            overrides["PYTHONPATH"] = os.pathsep.join(driver_path)
+            pid = agent.call("spawn", overrides, log_name, timeout=30.0)
+            rec.process = _RemoteProcess(agent, pid, rec.node_id)
+        else:
+            env = dict(os.environ)
+            env.update(rec.spec.env)
+            env[ENV_HEAD] = self.server.url
+            env[ENV_ACTOR_ID] = rec.spec.actor_id
+            env[ENV_SESSION] = self.session_id
+            env[ENV_SESSION_DIR] = self.session_dir
+            # child must resolve every module the driver can (cloudpickle
+            # pickles classes by reference): prepend the driver's sys.path
+            driver_path = [p for p in sys.path if p]
+            existing = env.get("PYTHONPATH")
+            if existing:
+                driver_path.append(existing)
+            env["PYTHONPATH"] = os.pathsep.join(driver_path)
+            log_path = os.path.join(self.session_dir, "logs",
+                                    f"{log_name}.out")
+            out = open(log_path, "ab")
+            rec.process = subprocess.Popen(
+                [sys.executable, "-m", "raydp_tpu.runtime.actor_main"],
+                env=env, stdout=out, stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+            out.close()
         rec.state = PENDING if rec.restart_count == 0 else RESTARTING
 
     def on_actor_ready(self, actor_id: str, address: tuple) -> None:
@@ -381,14 +467,8 @@ class RuntimeContext:
                 return
             rec.deliberate_kill = no_restart
             proc = rec.process
-        if proc is not None and proc.poll() is None:
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                try:
-                    proc.kill()
-                except ProcessLookupError:
-                    pass
+        if proc is not None:
+            _terminate(proc)
         # supervisor loop will observe the exit and apply restart-vs-dead policy
 
     def owner_key(self, rec: ActorRecord) -> str:
@@ -396,56 +476,89 @@ class RuntimeContext:
 
     def _supervise(self) -> None:
         while not self._stopped.is_set():
+            try:
+                self._supervise_once()
+            except Exception:  # noqa: BLE001 - the supervisor must never die
+                logger.exception("supervisor tick failed; continuing")
+            time.sleep(0.1)
+
+    def _supervise_once(self) -> None:
+        with self._lock:
+            items = list(self.records.items())
+        for actor_id, rec in items:
+            if rec.state == DEAD or rec.process is None:
+                continue
+            code = rec.process.poll()
+            if code is None:
+                continue
+            if (isinstance(rec.process, _RemoteProcess)
+                    and rec.process.lost):
+                # unreachable agent = node death: reap the whole node so
+                # every actor on it reroutes, not just this one
+                self._agent_lost(rec.process.node_id)
             with self._lock:
-                items = list(self.records.items())
-            for actor_id, rec in items:
-                if rec.state == DEAD or rec.process is None:
+                if rec.state == DEAD:
                     continue
-                code = rec.process.poll()
-                if code is None:
-                    continue
-                with self._lock:
-                    if rec.state == DEAD:
+                rec.ready.clear()
+                rec.address = None
+                if rec.node_id and rec.resources_held:
+                    self.resource_manager.release(rec.node_id, rec.resources_held)
+                    rec.resources_held = {}
+                limit = rec.spec.max_restarts
+                can_restart = (not rec.deliberate_kill
+                               and (limit == -1 or rec.restart_count < limit))
+                if can_restart:
+                    rec.restart_count += 1
+                    rec.was_restarted = True
+                    rec.state = RESTARTING
+                    node_id, held = self._replacement_node(rec)
+                    if node_id is None:
+                        # leave RESTARTING: retried next tick (pending resources)
+                        rec.process = None
                         continue
-                    rec.ready.clear()
-                    rec.address = None
-                    if rec.node_id and rec.resources_held:
-                        self.resource_manager.release(rec.node_id, rec.resources_held)
-                        rec.resources_held = {}
-                    limit = rec.spec.max_restarts
-                    can_restart = (not rec.deliberate_kill
-                                   and (limit == -1 or rec.restart_count < limit))
-                    if can_restart:
-                        rec.restart_count += 1
-                        rec.was_restarted = True
-                        rec.state = RESTARTING
-                        node_id, held = self._replacement_node(rec)
-                        if node_id is None:
-                            # leave RESTARTING: retried next tick (pending resources)
-                            rec.process = None
-                            continue
+                    rec.node_id = node_id
+                    rec.resources_held = held
+                    logger.warning(
+                        "actor %s exited with code %s; restarting (attempt %d)",
+                        rec.spec.name or actor_id, code, rec.restart_count)
+                    self._spawn_supervised(rec)
+                else:
+                    rec.state = DEAD
+                    rec.process = None
+                    logger.info("actor %s exited with code %s; dead",
+                                rec.spec.name or actor_id, code)
+                    self.store_server.free_owned_by(self.owner_key(rec))
+        # pending RESTARTING actors with no process: retry placement
+        with self._lock:
+            for rec in self.records.values():
+                if rec.state == RESTARTING and rec.process is None:
+                    node_id, held = self._replacement_node(rec)
+                    if node_id is not None:
                         rec.node_id = node_id
                         rec.resources_held = held
-                        logger.warning(
-                            "actor %s exited with code %s; restarting (attempt %d)",
-                            rec.spec.name or actor_id, code, rec.restart_count)
-                        self._spawn(rec)
-                    else:
-                        rec.state = DEAD
-                        rec.process = None
-                        logger.info("actor %s exited with code %s; dead",
-                                    rec.spec.name or actor_id, code)
-                        self.store_server.free_owned_by(self.owner_key(rec))
-            # pending RESTARTING actors with no process: retry placement
-            with self._lock:
-                for rec in self.records.values():
-                    if rec.state == RESTARTING and rec.process is None:
-                        node_id, held = self._replacement_node(rec)
-                        if node_id is not None:
-                            rec.node_id = node_id
-                            rec.resources_held = held
-                            self._spawn(rec)
-            time.sleep(0.1)
+                        self._spawn_supervised(rec)
+
+    def _spawn_supervised(self, rec: ActorRecord) -> None:
+        """Spawn from the supervisor thread: a failed spawn (e.g. the target
+        node's agent just died) must not kill the supervisor — the record
+        stays RESTARTING and is re-placed next tick, and an unreachable
+        agent's node is reaped."""
+        try:
+            self._spawn(rec)
+        except Exception as e:  # noqa: BLE001 - supervisor must survive
+            from raydp_tpu.runtime.rpc import RemoteError
+
+            logger.warning("spawn of %s on %s failed (%s); will re-place",
+                           rec.spec.name or rec.spec.actor_id, rec.node_id, e)
+            if (rec.node_id in self.node_agents
+                    and not isinstance(e, RemoteError)):
+                # transport failure → the agent itself is unreachable
+                self._agent_lost(rec.node_id)
+            if rec.node_id and rec.resources_held:
+                self.resource_manager.release(rec.node_id, rec.resources_held)
+                rec.resources_held = {}
+            rec.process = None
+            rec.state = RESTARTING
 
     def _replacement_node(self, rec: ActorRecord):
         """Node for a restarting actor: its placement-group bundle if the group
@@ -462,6 +575,31 @@ class RuntimeContext:
         return node_id, (dict(spec.resources) if node_id is not None else {})
 
     # ---- nodes --------------------------------------------------------------
+    def register_node_agent(self, host: str, port: int,
+                            resources: Dict[str, float],
+                            address: str) -> Dict[str, Any]:
+        from raydp_tpu.runtime.rpc import RpcClient
+
+        client = RpcClient((host, int(port)))
+        node_id = self.resource_manager.add_node(address, resources)
+        with self._lock:
+            self.node_agents[node_id] = client
+        logger.info("node agent registered: %s at %s:%d (%s)",
+                    node_id, host, port, resources)
+        return {"node_id": node_id, "session_id": self.session_id,
+                "session_dir": self.session_dir}
+
+    def _agent_lost(self, node_id: str) -> None:
+        agent = self.node_agents.pop(node_id, None)
+        if agent is None:
+            return
+        try:
+            agent.close()
+        except Exception:
+            pass
+        logger.warning("node agent for %s unreachable; removing node", node_id)
+        self.remove_node(node_id)
+
     def remove_node(self, node_id: str) -> None:
         """Fault injection: node death kills its actors; restartable actors are
         revived on surviving nodes (parity: test_spark_cluster.py:262-299)."""
@@ -491,15 +629,19 @@ class RuntimeContext:
             recs = list(self.records.values())
         for rec in recs:
             rec.deliberate_kill = True
-            if rec.process is not None and rec.process.poll() is None:
-                try:
-                    os.killpg(rec.process.pid, signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    try:
-                        rec.process.kill()
-                    except ProcessLookupError:
-                        pass
+            if rec.process is not None:
+                _terminate(rec.process)
             rec.state = DEAD
+        for node_id, agent in list(self.node_agents.items()):
+            try:
+                agent.call("shutdown", timeout=5.0)
+            except Exception:
+                pass
+            try:
+                agent.close()
+            except Exception:
+                pass
+        self.node_agents.clear()
         self.store_client.close()
         self.store_server.shutdown()
         self.server.stop()

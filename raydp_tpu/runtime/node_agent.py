@@ -1,0 +1,212 @@
+"""Node agent: the per-machine daemon that gives the head real multi-node
+placement (``python -m raydp_tpu.runtime.node_agent --head HOST:PORT``).
+
+This supplies the substrate role Ray's raylet plays for the reference (SURVEY.md
+§1 L1; the reference adopts real node/raylet addresses in
+ray_cluster_master.py:185-203): it registers the machine as a node with the
+head, then spawns/polls/kills actor processes on request, so ``node_id``
+affinity and placement-group bundles resolve to real processes on the agent's
+machine instead of bookkeeping entries at 127.0.0.1. The head supervises the
+agent connection; an unreachable agent is node death — its actors are killed
+from the records and restartable ones revive on surviving nodes.
+
+Object-store note: actor processes attach the session's shared-memory segments
+directly, so agents on the *same* machine share the data plane zero-copy.
+Agents on other machines carry control-plane traffic over the same RPC; bulk
+payload reads from a remote store segment go through the head's table server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from raydp_tpu.log import get_logger, init_logging
+from raydp_tpu.runtime.rpc import MethodDispatcher, RpcServer, connect_with_retry
+
+logger = get_logger("node_agent")
+
+
+try:  # load libc at import: CDLL inside a post-fork preexec_fn can
+    # deadlock/fail silently in a threaded parent (malloc locks)
+    import ctypes
+
+    _LIBC = ctypes.CDLL("libc.so.6", use_errno=True)
+except Exception:  # pragma: no cover - non-glibc platform
+    _LIBC = None
+
+
+def _die_with_parent():
+    """PR_SET_PDEATHSIG: actor processes die with their agent, the way a
+    node's workers die with its raylet — killing the agent IS node death,
+    and no orphan keeps serving a stale actor address. Runs between fork and
+    exec; must only make async-signal-safe calls (the prctl syscall is)."""
+    if _LIBC is not None:
+        _LIBC.prctl(1, signal.SIGKILL)  # 1 = PR_SET_PDEATHSIG
+
+
+class NodeAgentService:
+    """RPC surface the head drives: spawn/poll/kill actor processes here."""
+
+    def __init__(self, agent: "NodeAgent"):
+        self._agent = agent
+
+    def spawn(self, env_overrides: Dict[str, str], log_name: str) -> int:
+        return self._agent.spawn(env_overrides, log_name)
+
+    def poll(self, pid: int) -> Optional[int]:
+        return self._agent.poll(pid)
+
+    def kill(self, pid: int) -> bool:
+        return self._agent.kill(pid)
+
+    def list_pids(self) -> Dict[int, Optional[int]]:
+        return {pid: self._agent.poll(pid) for pid in list(self._agent.procs)}
+
+    def shutdown(self) -> bool:
+        threading.Thread(target=self._agent.stop, daemon=True).start()
+        return True
+
+    def ping(self) -> str:
+        return "pong"
+
+
+class NodeAgent:
+    def __init__(self, head_url: str, resources: Dict[str, float],
+                 log_dir: Optional[str] = None):
+        self.head_url = head_url
+        self.resources = resources
+        host, port = head_url.rsplit(":", 1)
+        self.head = connect_with_retry((host, int(port)))
+        self.server = RpcServer(MethodDispatcher(NodeAgentService(self)),
+                                host=self.head.local_host, port=0,
+                                max_concurrency=8, name="node-agent")
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+
+        reply = self.head.call(
+            "register_node_agent", self.server.address[0],
+            self.server.address[1], dict(resources), self.head.local_host)
+        self.node_id = reply["node_id"]
+        self.session_id = reply["session_id"]
+        self.session_dir = reply["session_dir"]
+        self.log_dir = log_dir or os.path.join(self.session_dir, "logs")
+        os.makedirs(self.log_dir, exist_ok=True)
+        logger.info("node agent %s registered with %s (resources=%s)",
+                    self.node_id, head_url, resources)
+
+    # ---- process management (driven by the head) ----------------------------
+    def spawn(self, env_overrides: Dict[str, str], log_name: str) -> int:
+        env = dict(os.environ)
+        env.update(env_overrides)
+        # the child resolves driver-pickled classes by reference: the head's
+        # forwarded PYTHONPATH (driver sys.path) takes precedence — matching
+        # local-spawn semantics so one session never runs two code versions —
+        # with this agent's own import path appended as fallback
+        paths = ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        paths.extend(p for p in sys.path if p)
+        env["PYTHONPATH"] = os.pathsep.join(paths)
+        log_path = os.path.join(self.log_dir, f"{log_name}.out")
+        out = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "raydp_tpu.runtime.actor_main"],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+            start_new_session=True, preexec_fn=_die_with_parent)
+        out.close()
+        with self._lock:
+            self.procs[proc.pid] = proc
+        logger.info("spawned actor process %d (%s)", proc.pid, log_name)
+        return proc.pid
+
+    def poll(self, pid: int) -> Optional[int]:
+        with self._lock:
+            proc = self.procs.get(pid)
+        if proc is None:
+            return -1  # unknown pid: report dead
+        return proc.poll()
+
+    def kill(self, pid: int) -> bool:
+        with self._lock:
+            proc = self.procs.get(pid)
+        if proc is None or proc.poll() is not None:
+            return False
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            try:
+                proc.kill()
+            except ProcessLookupError:
+                pass
+        return True
+
+    # ---- lifecycle ----------------------------------------------------------
+    def serve_forever(self) -> None:
+        """Heartbeat the head; die (reaping children) when it goes away."""
+        try:
+            while not self._stopped.is_set():
+                self.head.call("ping", timeout=30.0)
+                time.sleep(2.0)
+        except Exception:
+            logger.warning("head connection lost; shutting down")
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        with self._lock:
+            procs = list(self.procs.values())
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    try:
+                        proc.kill()
+                    except ProcessLookupError:
+                        pass
+        self.server.stop()
+        logger.info("node agent %s stopped", self.node_id)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="raydp_tpu node agent: joins a head as a schedulable node")
+    ap.add_argument("--head", required=True, help="head RPC address host:port")
+    ap.add_argument("--cpus", type=float, default=float(os.cpu_count() or 4))
+    ap.add_argument("--memory", type=float, default=None,
+                    help="bytes; default 80%% of RAM")
+    ap.add_argument("--resource", action="append", default=[],
+                    metavar="NAME=AMOUNT",
+                    help="extra custom resource (repeatable)")
+    ap.add_argument("--log-dir", default=None)
+    args = ap.parse_args()
+
+    mem = args.memory
+    if mem is None:
+        try:
+            import psutil
+            mem = float(int(psutil.virtual_memory().total * 0.8))
+        except Exception:
+            mem = float(8 << 30)
+    resources = {"CPU": args.cpus, "memory": mem}
+    for item in args.resource:
+        name, _, amount = item.partition("=")
+        resources[name] = float(amount or 1.0)
+
+    init_logging("node-agent", os.environ.get("RDT_LOG_LEVEL", "INFO"),
+                 None, None)
+    agent = NodeAgent(args.head, resources, log_dir=args.log_dir)
+    agent.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -321,17 +321,16 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 m.update(s, preds, labels) for m, s in zip(metrics, mstats))
             return new_state, loss_sum + loss_val.astype(jnp.float32), new_mstats
 
-        def eval_step(state, batch, mstats, loss_sum, count):
+        def eval_step(state, batch, mstats, loss_sum):
             preds, labels, _ = _apply(state.params, state.batch_stats, batch,
                                       train=False)
             loss_val = loss_fn(preds, labels).astype(jnp.float32)
-            n = labels.shape[0]
             new_mstats = tuple(
                 m.update(s, preds, labels) for m, s in zip(metrics, mstats))
-            return loss_sum + loss_val * n, count + n, new_mstats
+            return loss_sum + loss_val * labels.shape[0], new_mstats
 
         jit_train = jax.jit(train_step, donate_argnums=(0, 3))
-        jit_eval = jax.jit(eval_step, donate_argnums=(3, 4))
+        jit_eval = jax.jit(eval_step, donate_argnums=(3,))
 
         history: List[Dict[str, float]] = []
         epoch = 0
@@ -376,18 +375,12 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 if eval_feed is not None:
                     estats = tuple(m.init() for m in metrics)
                     esum = np.zeros((), np.float32)
-                    ecnt = np.zeros((), np.float32)
-                    esteps = 0
+                    ecnt = 0  # exact host-side int (shapes are static, no sync)
                     for batch in eval_feed:
-                        esum, ecnt, estats = jit_eval(state, batch, estats,
-                                                      esum, ecnt)
-                        esteps += 1
-                    if esteps:
-                        total = float(ecnt)
-                        report["eval_loss"] = (float(esum) / total) if total \
-                            else float("nan")
-                    else:
-                        report["eval_loss"] = float("nan")
+                        ecnt += int(next(iter(batch.values())).shape[0])
+                        esum, estats = jit_eval(state, batch, estats, esum)
+                    report["eval_loss"] = (float(esum) / ecnt) if ecnt \
+                        else float("nan")
                     for m, s in zip(metrics, estats):
                         report[f"eval_{m.name}"] = m.compute(
                             jax.tree.map(np.asarray, s))
@@ -475,8 +468,8 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                               timeout=start_timeout)
         attempts = 0
         while True:
-            job.start()
             try:
+                job.start()
                 results = job.run(_rank_fit, timeout=run_timeout)
                 job.stop()
                 break

@@ -1,0 +1,114 @@
+"""Node agents: real multi-node actor placement.
+
+Parity: the substrate role Ray's raylets play for the reference (SURVEY.md §1
+L1; ray_cluster_master.py:185-203 adopts real node addresses). Two agent
+daemons join a head; node-affinity actors land in the agents' processes; a
+killed agent reads as node death and its restartable actors reroute.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+class Echo:
+    def pids(self):
+        return {"pid": os.getpid(), "ppid": os.getppid()}
+
+    def get(self, x):
+        return x
+
+
+def _start_agent(head_url, cpus=4.0):
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "raydp_tpu.runtime.node_agent",
+         "--head", head_url, "--cpus", str(cpus)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        start_new_session=True)
+    return proc
+
+
+def _wait_nodes(rt, n, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        alive = [x for x in rt.resource_manager.nodes() if x.alive]
+        if len(alive) >= n:
+            return alive
+        time.sleep(0.2)
+    raise TimeoutError(f"never saw {n} alive nodes")
+
+
+def test_agents_join_and_affinity_placement(runtime):
+    rt = runtime
+    a1 = _start_agent(rt.server.url)
+    a2 = _start_agent(rt.server.url)
+    try:
+        _wait_nodes(rt, 3)  # driver node + 2 agent nodes
+        agent_nodes = sorted(rt.node_agents)
+        assert len(agent_nodes) == 2
+
+        # node-affinity: the actor must land in agent #2's process tree
+        target = agent_nodes[1]
+        h = runtime.create_actor(Echo, name="remote-echo", node_id=target,
+                                 resources={"CPU": 1.0})
+        info = h.pids()
+        agent_pids = {a1.pid, a2.pid}
+        assert info["ppid"] in agent_pids, (
+            f"actor parent {info['ppid']} is not a node agent {agent_pids}")
+        assert info["ppid"] != os.getpid()
+        # and specifically the agent serving `target`
+        listed = rt.node_agents[target].call("list_pids")
+        assert info["pid"] in {int(p) for p in listed}
+    finally:
+        for p in (a1, a2):
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                p.kill()
+
+
+def test_agent_death_reroutes_restartable_actor(runtime):
+    rt = runtime
+    a1 = _start_agent(rt.server.url)
+    try:
+        _wait_nodes(rt, 2)
+        (agent_node,) = list(rt.node_agents)
+        h = runtime.create_actor(Echo, name="nomad", node_id=agent_node,
+                                 resources={"CPU": 1.0}, max_restarts=-1)
+        first = h.pids()
+        assert first["ppid"] == a1.pid
+
+        # node death: kill the agent (its children die with it)
+        os.killpg(a1.pid, signal.SIGKILL)
+
+        deadline = time.time() + 60.0
+        second = None
+        while time.time() < deadline:
+            try:
+                got = h.pids()
+                if got["pid"] != first["pid"]:
+                    second = got
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        assert second is not None, "actor never revived after agent death"
+        # revived on the surviving (driver) node: parent is this process
+        assert second["ppid"] == os.getpid()
+        assert second["pid"] != first["pid"]
+        # the dead agent's node is gone from the alive set
+        node = rt.resource_manager.get_node(agent_node)
+        assert node is None or not node.alive
+        assert agent_node not in rt.node_agents
+    finally:
+        try:
+            os.killpg(a1.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
