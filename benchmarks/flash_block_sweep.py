@@ -1,0 +1,70 @@
+"""Flash-attention block-size sweep on the real chip (VERDICT #5).
+
+Times the Pallas forward+backward through ``flash_attention`` for a grid of
+(block_q, block_k) at long context, printing μs/call and the best pair — the
+evidence behind the DEFAULT_BLOCK_* choices.
+
+Run: python benchmarks/flash_block_sweep.py [--seq-len 8192] [--dim 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, default=8192)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--dim", type=int, default=64, help="head dim")
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raydp_tpu.ops.flash_attention import flash_attention
+
+    B, T, H, D = args.batch, args.seq_len, args.heads, args.dim
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, T, H, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, T, H, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, T, H, D), jnp.bfloat16)
+
+    results = []
+    grid = [(128, 128), (128, 256), (256, 256), (256, 512), (512, 512),
+            (512, 1024), (1024, 1024)]
+    for bq, bk in grid:
+            if bq > T or bk > T:
+                continue
+
+            def loss(q, bq=bq, bk=bk):
+                return flash_attention(q, k, v, causal=True,
+                                       block_q=bq, block_k=bk).sum()
+
+            step = jax.jit(jax.grad(loss))
+            g = step(q)
+            jax.block_until_ready(g)
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                g = step(q)
+            jax.block_until_ready(g)
+            us = (time.perf_counter() - t0) / args.iters * 1e6
+            results.append((us, bq, bk))
+            print(f"blk_q={bq:5d} blk_k={bk:5d}  {us:9.1f} us/fwd+bwd",
+                  file=sys.stderr)
+    best = min(results)
+    print(f"best: blk_q={best[1]} blk_k={best[2]} ({best[0]:.1f} us) "
+          f"at B={B} T={T} H={H} D={D} on "
+          f"{jax.devices()[0].device_kind}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+"""Self-measured reference baseline: the reference's NYCTaxi workload in torch.
+
+The reference (pang-wu/raydp) publishes no numbers (BASELINE.md), and its
+stack (Spark+Ray+raydp JVM) is not installable in this environment — so this
+reproduces the *workload* of `examples/pytorch_nyctaxi.py` faithfully on CPU
+torch and measures end-to-end samples/sec: the same synthetic NYCTaxi data,
+the same preprocessing (clean_up + time + distance features, pandas standing
+in for the Spark stage), the same 5-layer BatchNorm MLP (256-128-64-16-1,
+reference examples/pytorch_nyctaxi.py:69-92), SmoothL1 + Adam(1e-3), batch 64
+(reference :98-102), DataLoader feed. Steady-state throughput skips epoch 0.
+
+Run: python benchmarks/reference_nyctaxi_torch.py [--rows 400000] [--epochs 3]
+Record the number in BASELINE.md and bench.py's REF_BASELINE.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples"))
+
+
+def preprocess_pandas(df: pd.DataFrame) -> pd.DataFrame:
+    """The reference's data_process.py pipeline, vectorized over pandas."""
+    df = df[
+        (df.pickup_longitude <= -72) & (df.pickup_longitude >= -76)
+        & (df.dropoff_longitude <= -72) & (df.dropoff_longitude >= -76)
+        & (df.pickup_latitude <= 42) & (df.pickup_latitude >= 38)
+        & (df.dropoff_latitude <= 42) & (df.dropoff_latitude >= 38)
+        & (df.passenger_count <= 6) & (df.passenger_count >= 1)
+        & (df.fare_amount > 0) & (df.fare_amount < 250)
+        & (df.dropoff_longitude != df.pickup_longitude)
+        & (df.dropoff_latitude != df.pickup_latitude)
+    ].copy()
+    ts = pd.to_datetime(df.pop("pickup_datetime"))
+    df["day"] = ts.dt.day
+    df["hour_of_day"] = ts.dt.hour
+    df["day_of_week"] = ts.dt.dayofweek
+    df["week_of_year"] = ts.dt.isocalendar().week.astype(np.int64)
+    df["month_of_year"] = ts.dt.month
+    df["quarter_of_year"] = ts.dt.quarter
+    df["year"] = ts.dt.year
+    df["night"] = ((df.hour_of_day >= 16) & (df.hour_of_day <= 20)
+                   & (df.day_of_week < 5)).astype(np.int64)
+    df["late_night"] = ((df.hour_of_day <= 6)
+                        | (df.hour_of_day >= 20)).astype(np.int64)
+    df["abs_diff_longitude"] = (df.dropoff_longitude
+                                - df.pickup_longitude).abs()
+    df["abs_diff_latitude"] = (df.dropoff_latitude - df.pickup_latitude).abs()
+    df["manhattan"] = df.abs_diff_longitude + df.abs_diff_latitude
+    airports = {"jfk": (-73.7781, 40.6413), "ewr": (-74.1745, 40.6895),
+                "lgr": (-73.8740, 40.7769), "downtown": (-74.0060, 40.7128)}
+    for name, (lon, lat) in airports.items():
+        df[f"pickup_distance_{name}"] = np.sqrt(
+            (df.pickup_longitude - lon) ** 2 + (df.pickup_latitude - lat) ** 2)
+        df[f"dropoff_distance_{name}"] = np.sqrt(
+            (df.dropoff_longitude - lon) ** 2
+            + (df.dropoff_latitude - lat) ** 2)
+    return df
+
+
+def main():
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as TF
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=400_000)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    from generate_nyctaxi import generate
+
+    t_etl = time.perf_counter()
+    df = preprocess_pandas(generate(args.rows))
+    label = df.pop("fare_amount").to_numpy(np.float32)
+    feats = df.to_numpy(np.float32)
+    etl_s = time.perf_counter() - t_etl
+
+    class NYCModel(nn.Module):
+        # same topology as the reference model (pytorch_nyctaxi.py:69-92)
+        def __init__(self, cols):
+            super().__init__()
+            widths = [256, 128, 64, 16]
+            self.layers = nn.ModuleList()
+            self.norms = nn.ModuleList()
+            prev = cols
+            for w in widths:
+                self.layers.append(nn.Linear(prev, w))
+                self.norms.append(nn.BatchNorm1d(w))
+                prev = w
+            self.head = nn.Linear(prev, 1)
+
+        def forward(self, x):
+            for lin, bn in zip(self.layers, self.norms):
+                x = bn(TF.relu(lin(x)))
+            return self.head(x)
+
+    torch.set_num_threads(os.cpu_count() or 4)
+    model = NYCModel(feats.shape[1])
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    crit = nn.SmoothL1Loss()
+    ds = torch.utils.data.TensorDataset(
+        torch.from_numpy(feats), torch.from_numpy(label))
+    loader = torch.utils.data.DataLoader(ds, batch_size=args.batch_size,
+                                         shuffle=True)
+
+    rates = []
+    for epoch in range(args.epochs):
+        t0 = time.perf_counter()
+        seen = 0
+        for xb, yb in loader:
+            opt.zero_grad()
+            loss = crit(model(xb).squeeze(-1), yb)
+            loss.backward()
+            opt.step()
+            seen += xb.shape[0]
+        dt = time.perf_counter() - t0
+        rates.append(seen / dt)
+        print(f"epoch {epoch}: {seen} samples in {dt:.1f}s "
+              f"({seen / dt:.0f} samples/s) loss={float(loss):.4f}",
+              file=sys.stderr)
+    steady = rates[1:] or rates
+    print(f"# etl_s={etl_s:.1f} rows={args.rows} batch={args.batch_size}",
+          file=sys.stderr)
+    print(f"{sum(steady) / len(steady):.1f}")
+
+
+if __name__ == "__main__":
+    main()

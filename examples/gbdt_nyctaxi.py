@@ -1,0 +1,74 @@
+"""NYCTaxi fare regression with XLA-native gradient-boosted trees.
+
+The port of the reference's XGBoost example (examples/xgboost_ray_nyctaxi.py:
+Spark ETL → XGBoostTrainer over Rabit): the same ETL feeds
+:class:`raydp_tpu.train.GBDTEstimator`, whose histogram trees are dense XLA
+array programs (segment-sum histograms + gain scans). Demonstrates per-round
+eval reporting and early stopping.
+
+Run: python examples/gbdt_nyctaxi.py [--rows 100000] [--rounds 100]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=100_000)
+    ap.add_argument("--rounds", type=int, default=100)
+    ap.add_argument("--max-depth", type=int, default=6)
+    ap.add_argument("--early-stopping-rounds", type=int, default=10)
+    ap.add_argument("--num-executors", type=int, default=2)
+    ap.add_argument("--csv", default=None)
+    args = ap.parse_args()
+
+    import raydp_tpu
+    from nyctaxi_features import LABEL, feature_columns, nyc_taxi_preprocess
+    from raydp_tpu.train import GBDTEstimator
+
+    csv_path = args.csv
+    if csv_path is None:
+        from generate_nyctaxi import generate
+        csv_path = os.path.join(tempfile.mkdtemp(), "nyctaxi.csv")
+        generate(args.rows).to_csv(csv_path, index=False)
+
+    session = raydp_tpu.init("gbdt-nyctaxi", num_executors=args.num_executors,
+                             executor_cores=1, executor_memory="1GB")
+    try:
+        data = session.read.csv(csv_path, num_partitions=args.num_executors * 2)
+        data = nyc_taxi_preprocess(data)
+        train_df, test_df = data.randomSplit([0.9, 0.1], seed=0)
+        features = feature_columns(data)
+
+        est = GBDTEstimator(
+            # xgboost-style params (reference xgboost_ray_nyctaxi.py:60-75)
+            params={"objective": "reg:squarederror",
+                    "max_depth": args.max_depth, "eta": 0.3},
+            feature_columns=features,
+            label_column=LABEL,
+            num_boost_round=args.rounds,
+            early_stopping_rounds=args.early_stopping_rounds,
+        )
+        result = est.fit_on_frame(train_df, test_df)
+        print(result.history[-1])
+        rounds = est.evals_result.get("eval_rmse", [])
+        if rounds:
+            print(f"eval rmse by round: first={rounds[0]:.4f} "
+                  f"best={min(rounds):.4f} rounds_run={len(rounds)}")
+        model = est.get_model()
+        print(f"forest: {model.num_trees} trees, "
+              f"best_iteration={model.best_iteration}")
+    finally:
+        raydp_tpu.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""NYCTaxi fare regression through the KerasEstimator (Keras 3, JAX backend).
+
+The port of the reference's TFEstimator example (examples/tensorflow_nyctaxi.py:
+Spark ETL → TFEstimator with MultiWorkerMirroredStrategy). Here the same ETL
+feeds a Keras model compiled by XLA; ``data_parallel=True`` shards each batch
+over all local devices (the MWMS replacement).
+
+Run: python examples/keras_nyctaxi.py [--rows 100000] [--epochs 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=100_000)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=1024)
+    ap.add_argument("--num-executors", type=int, default=2)
+    ap.add_argument("--csv", default=None)
+    args = ap.parse_args()
+
+    import raydp_tpu
+    from nyctaxi_features import LABEL, feature_columns, nyc_taxi_preprocess
+    from raydp_tpu.train import KerasEstimator
+
+    csv_path = args.csv
+    if csv_path is None:
+        from generate_nyctaxi import generate
+        csv_path = os.path.join(tempfile.mkdtemp(), "nyctaxi.csv")
+        generate(args.rows).to_csv(csv_path, index=False)
+
+    session = raydp_tpu.init("keras-nyctaxi", num_executors=args.num_executors,
+                             executor_cores=1, executor_memory="1GB")
+    try:
+        data = session.read.csv(csv_path, num_partitions=args.num_executors * 2)
+        data = nyc_taxi_preprocess(data)
+        train_df, test_df = data.randomSplit([0.9, 0.1], seed=0)
+        features = feature_columns(data)
+
+        def build_model():
+            import keras
+            # the reference example's layer stack (tensorflow_nyctaxi.py)
+            return keras.Sequential([
+                keras.layers.Input(shape=(len(features),)),
+                keras.layers.Dense(256, activation="relu"),
+                keras.layers.BatchNormalization(),
+                keras.layers.Dense(128, activation="relu"),
+                keras.layers.BatchNormalization(),
+                keras.layers.Dense(64, activation="relu"),
+                keras.layers.Dense(1),
+            ])
+
+        import jax
+        est = KerasEstimator(
+            model_builder=build_model,
+            optimizer="adam",
+            loss="mse",
+            metrics=["mae"],
+            feature_columns=features,
+            label_column=LABEL,
+            batch_size=args.batch_size,
+            num_epochs=args.epochs,
+            data_parallel=len(jax.devices()) > 1,
+        )
+        result = est.fit_on_frame(train_df, test_df)
+        for row in result.history:
+            print(row)
+        print("model saved under:", result.checkpoint_dir)
+    finally:
+        raydp_tpu.stop()
+
+
+if __name__ == "__main__":
+    main()

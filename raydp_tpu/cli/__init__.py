@@ -1,0 +1,1 @@
+"""Command-line entry points (parity: the reference's bin/raydp-submit)."""

@@ -1,0 +1,99 @@
+"""``rdt-submit`` — non-inline job submission.
+
+Parity: the reference's ``bin/raydp-submit`` + SparkSubmit fork (the fork's one
+load-bearing change is accepting ``--master ray``, SparkSubmit.scala:231-240;
+the wrapper assembles classpaths and forwards ``--conf``). Here there is no
+JVM to assemble: the CLI packages the cluster configuration into the
+environment and execs the user script in a child interpreter —
+``raydp_tpu.init`` inside the script resolves any argument the script left at
+its default from the submitted values (explicit arguments in code still win,
+Spark's precedence). The child's exit code is propagated, and SIGINT/SIGTERM
+forward to the child's process group.
+
+    rdt-submit --num-executors 4 --executor-cores 2 \\
+               --conf raydp.tpu.shuffle.partitions=16 train.py --epochs 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+from typing import List, Optional
+
+ENV_SUBMIT = "RDT_SUBMIT_ARGS"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="rdt-submit",
+        description="Run a raydp_tpu script with cluster configuration "
+                    "supplied at submit time (parity: bin/raydp-submit)")
+    ap.add_argument("--name", default=None, help="application name override")
+    ap.add_argument("--num-executors", type=int, default=None)
+    ap.add_argument("--executor-cores", type=int, default=None)
+    ap.add_argument("--executor-memory", default=None, help="e.g. 2GB")
+    ap.add_argument("--placement-group-strategy", default=None,
+                    choices=["PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"])
+    ap.add_argument("--conf", action="append", default=[], metavar="K=V",
+                    help="config entry (repeatable), e.g. raydp.tpu.x=y")
+    ap.add_argument("--env", action="append", default=[], metavar="K=V",
+                    help="extra environment for the script (repeatable)")
+    ap.add_argument("script", help="python script to run")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER,
+                    help="arguments passed through to the script")
+    return ap
+
+
+def _parse_kv(items: List[str], flag: str) -> dict:
+    out = {}
+    for item in items:
+        key, sep, value = item.partition("=")
+        if not sep or not key:
+            raise SystemExit(f"rdt-submit: {flag} expects K=V, got {item!r}")
+        out[key] = value
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if not os.path.exists(args.script):
+        raise SystemExit(f"rdt-submit: script not found: {args.script}")
+
+    submit = {
+        "app_name": args.name,
+        "num_executors": args.num_executors,
+        "executor_cores": args.executor_cores,
+        "executor_memory": args.executor_memory,
+        "placement_group_strategy": args.placement_group_strategy,
+        "configs": _parse_kv(args.conf, "--conf"),
+    }
+    env = dict(os.environ)
+    env.update(_parse_kv(args.env, "--env"))
+    env[ENV_SUBMIT] = json.dumps(
+        {k: v for k, v in submit.items() if v not in (None, {})})
+
+    proc = subprocess.Popen(
+        [sys.executable, args.script] + list(args.script_args),
+        env=env, start_new_session=True)
+
+    def _forward(signum, _frame):
+        try:
+            os.killpg(proc.pid, signum)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    old = {s: signal.signal(s, _forward)
+           for s in (signal.SIGINT, signal.SIGTERM)}
+    try:
+        return proc.wait()
+    finally:
+        for s, handler in old.items():
+            signal.signal(s, handler)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

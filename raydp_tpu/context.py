@@ -101,22 +101,55 @@ class _Context:
                 shutdown_runtime()
 
 
+def _submit_overrides() -> Dict:
+    """Configuration packaged by ``rdt-submit`` (parity: conf flowing from
+    bin/raydp-submit into the session). Explicit ``init`` arguments win;
+    submitted values fill anything the script left at its default."""
+    import json
+    import os
+
+    raw = os.environ.get("RDT_SUBMIT_ARGS")
+    if not raw:
+        return {}
+    try:
+        return json.loads(raw)
+    except ValueError:
+        logger.warning("ignoring malformed RDT_SUBMIT_ARGS")
+        return {}
+
+
 def init(
     app_name: str,
-    num_executors: int = 1,
-    executor_cores: int = 1,
-    executor_memory: Union[str, int] = "1GB",
+    num_executors: Optional[int] = None,
+    executor_cores: Optional[int] = None,
+    executor_memory: Union[str, int, None] = None,
     placement_group_strategy: Optional[str] = None,
     configs: Optional[Dict[str, str]] = None,
     virtual_nodes: Optional[List[Dict[str, float]]] = None,
 ):
     """Start the framework and return the ETL :class:`Session`.
 
-    Signature parity with ``raydp.init_spark`` (context.py:182-254). Extra,
+    Signature parity with ``raydp.init_spark`` (context.py:182-254); defaults:
+    1 executor × 1 core × 1GB. Under ``rdt-submit``, submitted values replace
+    the defaults of any argument not set explicitly here. Extra,
     TPU-build-specific knob: ``virtual_nodes`` registers logical nodes to simulate
     a multi-host topology in tests (the reference's tests get this from
     ``ray.cluster_utils.Cluster``, test_spark_cluster.py:90-110).
     """
+    sub = _submit_overrides()
+    app_name = app_name or sub.get("app_name") or "raydp-tpu"
+    if num_executors is None:
+        num_executors = int(sub.get("num_executors", 1))
+    if executor_cores is None:
+        executor_cores = int(sub.get("executor_cores", 1))
+    if executor_memory is None:
+        executor_memory = sub.get("executor_memory", "1GB")
+    if placement_group_strategy is None:
+        placement_group_strategy = sub.get("placement_group_strategy")
+    merged_configs = dict(sub.get("configs", {}))
+    merged_configs.update(configs or {})
+    configs = merged_configs or None
+
     global _global_context
     with _context_lock:
         if _global_context is not None:

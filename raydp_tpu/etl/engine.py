@@ -338,10 +338,14 @@ class Engine:
 
     def _compile_csv(self, node: P.CsvScan):
         tasks = []
+        headerless = bool((node.options or {}).get("column_names"))
         for path in node.paths:
             size = os.path.getsize(path)
-            with open(path, "rb") as f:
-                header = f.readline()
+            if headerless:
+                header = b""  # first line is data (column names via options)
+            else:
+                with open(path, "rb") as f:
+                    header = f.readline()
             body = size - len(header)
             nparts = node.num_partitions or max(
                 1, min(self.shuffle_partitions, body // (8 << 20) + 1))

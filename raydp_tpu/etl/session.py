@@ -232,10 +232,15 @@ class DataFrameReader:
         return getattr(self, fmt)(path)
 
     def csv(self, path: Union[str, List[str]],
-            num_partitions: Optional[int] = None) -> DataFrame:
-        paths = _expand_paths(path, (".csv",))
+            num_partitions: Optional[int] = None,
+            options: Optional[dict] = None) -> DataFrame:
+        """``options``: ``delimiter`` (default ','), ``column_names`` (for
+        headerless files, e.g. Criteo TSV), ``convert`` (pyarrow
+        ConvertOptions kwargs)."""
+        paths = _expand_paths(path, (".csv", ".tsv", ".txt"))
         return DataFrame(self._session,
-                         P.CsvScan(paths, num_partitions=num_partitions))
+                         P.CsvScan(paths, num_partitions=num_partitions,
+                                   options=options))
 
     def parquet(self, path: Union[str, List[str]],
                 columns: Optional[List[str]] = None) -> DataFrame:

@@ -81,11 +81,21 @@ class CsvSliceSource(Step):
                 if not data.endswith(b"\n"):
                     data += f.readline()
         payload = self.header + data if self.start > 0 else data
-        if not payload.strip():
-            return pacsv.read_csv(io.BytesIO(self.header))[:0]
         opts = self.parse_options or {}
+        names = opts.get("column_names")  # headerless files (e.g. Criteo TSV)
+        parse = pacsv.ParseOptions(delimiter=opts.get("delimiter", ","))
+        read = pacsv.ReadOptions(column_names=names) if names \
+            else pacsv.ReadOptions()
         convert = pacsv.ConvertOptions(**opts.get("convert", {}))
-        return pacsv.read_csv(io.BytesIO(payload), convert_options=convert)
+        if not payload.strip():
+            if names:
+                # null-typed empties promote to any sibling slice's inferred
+                # type under permissive concat (string would not)
+                return pa.table({n: pa.array([], pa.null()) for n in names})
+            return pacsv.read_csv(io.BytesIO(self.header),
+                                  parse_options=parse)[:0]
+        return pacsv.read_csv(io.BytesIO(payload), read_options=read,
+                              parse_options=parse, convert_options=convert)
 
 
 @dataclass

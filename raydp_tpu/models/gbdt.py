@@ -17,7 +17,12 @@ wants it — as dense, static-shape array programs:
   XLA insert ``psum``s for the histograms exactly where XGBoost's Rabit
   allreduce sits;
 - the boosting loop is a ``lax.scan`` over rounds, carrying predictions and
-  stacking per-tree tables.
+  stacking per-tree tables; with eval sets / early stopping the scan runs in
+  host-stepped chunks so per-round metrics come out without recompiling;
+- multiclass (``multi:softmax`` / ``multi:softprob``) builds K one-vs-rest
+  trees per round by ``vmap``-ing tree construction over the class axis of the
+  softmax gradients — K trees for the price of one compilation;
+- instance weights scale (g, h) before the histograms, xgboost-style.
 
 A "no split" is represented as threshold ``num_bins - 1`` (every row routes
 left), which lets gain-negative nodes degrade gracefully without ragged trees.
@@ -27,7 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,28 +41,43 @@ import numpy as np
 
 @dataclasses.dataclass
 class GBDTModel:
-    """A fitted forest: per-tree split/leaf tables + binning for inference."""
+    """A fitted forest: per-tree split/leaf tables + binning for inference.
 
-    split_feature: np.ndarray   # [T, 2**depth - 1] int32
-    split_bin: np.ndarray       # [T, 2**depth - 1] int32
-    leaf_value: np.ndarray      # [T, 2**depth] float32
+    Table shapes: ``[T, nodes]`` for single-output objectives;
+    ``[T, K, nodes]`` for multiclass (K trees per boosting round).
+    """
+
+    split_feature: np.ndarray   # [T, 2**depth - 1] or [T, K, 2**depth - 1]
+    split_bin: np.ndarray       # same leading shape
+    leaf_value: np.ndarray      # [T, 2**depth] or [T, K, 2**depth]
     bin_edges: np.ndarray       # [f, num_bins - 1] float32
-    base_score: float
+    base_score: np.ndarray      # scalar, or [K] for multiclass
     max_depth: int
     objective: str
+    best_iteration: Optional[int] = None   # set when early stopping fired
 
     @property
     def num_trees(self) -> int:
         return self.split_feature.shape[0]
 
+    @property
+    def num_class(self) -> int:
+        return self.leaf_value.shape[1] if self.leaf_value.ndim == 3 else 1
+
     def predict(self, X: np.ndarray, output_margin: bool = False) -> np.ndarray:
         Xb = apply_bins(np.asarray(X, dtype=np.float32), self.bin_edges)
-        margin = np.asarray(_predict_binned_jit(
-            jnp.asarray(Xb), jnp.asarray(self.split_feature),
-            jnp.asarray(self.split_bin), jnp.asarray(self.leaf_value),
-            self.max_depth) + self.base_score)
-        if self.objective == "binary:logistic" and not output_margin:
+        margin = predict_binned(Xb, self.split_feature, self.split_bin,
+                                self.leaf_value, self.max_depth)
+        margin = margin + self.base_score
+        if output_margin:
+            return margin
+        if self.objective == "binary:logistic":
             return 1.0 / (1.0 + np.exp(-margin))
+        if self.objective == "multi:softprob":
+            e = np.exp(margin - margin.max(axis=1, keepdims=True))
+            return e / e.sum(axis=1, keepdims=True)
+        if self.objective == "multi:softmax":
+            return margin.argmax(axis=1).astype(np.float32)
         return margin
 
 
@@ -76,86 +96,112 @@ def apply_bins(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
 
 
 def _grad_hess(pred, y, objective: str):
+    """(g, h) per row — shape [n] (single-output) or [n, K] (multiclass)."""
     if objective == "binary:logistic":
         p = jax.nn.sigmoid(pred)
         return p - y, p * (1.0 - p)
+    if objective.startswith("multi:"):
+        K = pred.shape[1]
+        p = jax.nn.softmax(pred, axis=-1)
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), K, dtype=pred.dtype)
+        return p - onehot, p * (1.0 - p)
     # reg:squarederror — ½(pred − y)²
     return pred - y, jnp.ones_like(pred)
 
 
-@partial(jax.jit, static_argnames=(
-    "num_trees", "max_depth", "num_bins", "objective"))
-def _fit_binned(Xb, y, *, num_trees: int, max_depth: int, num_bins: int,
-                learning_rate: float, reg_lambda: float, min_child_weight: float,
-                base_score: float, objective: str):
+def _build_tree(Xb, g, h, *, max_depth: int, num_bins: int,
+                learning_rate: float, reg_lambda: float,
+                min_child_weight: float):
+    """One tree for one (g, h) target; returns (split tables, leaf values,
+    per-row update)."""
     n, f = Xb.shape
     num_internal = 2 ** max_depth - 1
     num_leaves = 2 ** max_depth
     rows = jnp.arange(n)
     feat_ids = jnp.arange(f)
 
-    def build_tree(pred):
-        g, h = _grad_hess(pred, y, objective)
-        node = jnp.zeros(n, dtype=jnp.int32)  # level-local node index
-        split_feature = jnp.zeros(num_internal, dtype=jnp.int32)
-        split_bin = jnp.full(num_internal, num_bins - 1, dtype=jnp.int32)
+    node = jnp.zeros(n, dtype=jnp.int32)  # level-local node index
+    split_feature = jnp.zeros(num_internal, dtype=jnp.int32)
+    split_bin = jnp.full(num_internal, num_bins - 1, dtype=jnp.int32)
 
-        for depth in range(max_depth):  # static unroll: buffers double per level
-            level_nodes = 2 ** depth
-            offset = level_nodes - 1
-            # histograms over (node, feature, bin) via one scatter-add each
-            seg = (node[:, None] * f + feat_ids[None, :]) * num_bins + Xb
-            num_segments = level_nodes * f * num_bins
-            hist_g = jax.ops.segment_sum(
-                jnp.broadcast_to(g[:, None], (n, f)).ravel(), seg.ravel(),
-                num_segments=num_segments).reshape(level_nodes, f, num_bins)
-            hist_h = jax.ops.segment_sum(
-                jnp.broadcast_to(h[:, None], (n, f)).ravel(), seg.ravel(),
-                num_segments=num_segments).reshape(level_nodes, f, num_bins)
+    for depth in range(max_depth):  # static unroll: buffers double per level
+        level_nodes = 2 ** depth
+        offset = level_nodes - 1
+        # histograms over (node, feature, bin) via one scatter-add each
+        seg = (node[:, None] * f + feat_ids[None, :]) * num_bins + Xb
+        num_segments = level_nodes * f * num_bins
+        hist_g = jax.ops.segment_sum(
+            jnp.broadcast_to(g[:, None], (n, f)).ravel(), seg.ravel(),
+            num_segments=num_segments).reshape(level_nodes, f, num_bins)
+        hist_h = jax.ops.segment_sum(
+            jnp.broadcast_to(h[:, None], (n, f)).ravel(), seg.ravel(),
+            num_segments=num_segments).reshape(level_nodes, f, num_bins)
 
-            GL = jnp.cumsum(hist_g, axis=-1)
-            HL = jnp.cumsum(hist_h, axis=-1)
-            Gt = GL[..., -1:]
-            Ht = HL[..., -1:]
-            GR = Gt - GL
-            HR = Ht - HL
-            gain = (GL * GL / (HL + reg_lambda)
-                    + GR * GR / (HR + reg_lambda)
-                    - Gt * Gt / (Ht + reg_lambda))
-            ok = (HL >= min_child_weight) & (HR >= min_child_weight)
-            gain = jnp.where(ok, gain, -jnp.inf)
-            # bin B-1 keeps everything left — the canonical "no split"
-            gain = gain.at[..., num_bins - 1].set(0.0)
+        GL = jnp.cumsum(hist_g, axis=-1)
+        HL = jnp.cumsum(hist_h, axis=-1)
+        Gt = GL[..., -1:]
+        Ht = HL[..., -1:]
+        GR = Gt - GL
+        HR = Ht - HL
+        gain = (GL * GL / (HL + reg_lambda)
+                + GR * GR / (HR + reg_lambda)
+                - Gt * Gt / (Ht + reg_lambda))
+        ok = (HL >= min_child_weight) & (HR >= min_child_weight)
+        gain = jnp.where(ok, gain, -jnp.inf)
+        # bin B-1 keeps everything left — the canonical "no split"
+        gain = gain.at[..., num_bins - 1].set(0.0)
 
-            flat = gain.reshape(level_nodes, f * num_bins)
-            best = jnp.argmax(flat, axis=1)
-            best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-            bf = (best // num_bins).astype(jnp.int32)
-            bb = (best % num_bins).astype(jnp.int32)
-            no_split = best_gain <= 0.0
-            bf = jnp.where(no_split, 0, bf)
-            bb = jnp.where(no_split, num_bins - 1, bb)
+        flat = gain.reshape(level_nodes, f * num_bins)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        bf = (best // num_bins).astype(jnp.int32)
+        bb = (best % num_bins).astype(jnp.int32)
+        no_split = best_gain <= 0.0
+        bf = jnp.where(no_split, 0, bf)
+        bb = jnp.where(no_split, num_bins - 1, bb)
 
-            idx = offset + jnp.arange(level_nodes)
-            split_feature = split_feature.at[idx].set(bf)
-            split_bin = split_bin.at[idx].set(bb)
+        idx = offset + jnp.arange(level_nodes)
+        split_feature = split_feature.at[idx].set(bf)
+        split_bin = split_bin.at[idx].set(bb)
 
-            go_right = Xb[rows, bf[node]] > bb[node]
-            node = node * 2 + go_right.astype(jnp.int32)
+        go_right = Xb[rows, bf[node]] > bb[node]
+        node = node * 2 + go_right.astype(jnp.int32)
 
-        leaf_g = jax.ops.segment_sum(g, node, num_segments=num_leaves)
-        leaf_h = jax.ops.segment_sum(h, node, num_segments=num_leaves)
-        leaf_value = (-leaf_g / (leaf_h + reg_lambda)
-                      * learning_rate).astype(jnp.float32)
-        return split_feature, split_bin, leaf_value, leaf_value[node]
+    leaf_g = jax.ops.segment_sum(g, node, num_segments=num_leaves)
+    leaf_h = jax.ops.segment_sum(h, node, num_segments=num_leaves)
+    leaf_value = (-leaf_g / (leaf_h + reg_lambda)
+                  * learning_rate).astype(jnp.float32)
+    return split_feature, split_bin, leaf_value, leaf_value[node]
+
+
+@partial(jax.jit, static_argnames=(
+    "chunk", "max_depth", "num_bins", "objective"))
+def _boost_chunk(Xb, y, w, pred, *, chunk: int, max_depth: int, num_bins: int,
+                 learning_rate: float, reg_lambda: float,
+                 min_child_weight: float, objective: str):
+    """``chunk`` boosting rounds from ``pred``; returns (stacked trees, pred).
+
+    Compiled once per (shape, chunk); the host loop re-invokes it between
+    eval/early-stop checks without recompiling.
+    """
+    build = partial(_build_tree, max_depth=max_depth, num_bins=num_bins,
+                    learning_rate=learning_rate, reg_lambda=reg_lambda,
+                    min_child_weight=min_child_weight)
 
     def boost(pred, _):
-        split_feature, split_bin, leaf_value, update = build_tree(pred)
-        return pred + update, (split_feature, split_bin, leaf_value)
+        g, h = _grad_hess(pred, y, objective)
+        if g.ndim == 2:  # multiclass: K trees via vmap over the class axis
+            g = g * w[:, None]
+            h = h * w[:, None]
+            sf, sb, lv, upd = jax.vmap(
+                lambda gk, hk: build(Xb, gk, hk),
+                in_axes=1, out_axes=0)(g, h)     # tables [K, ...], upd [K, n]
+            return pred + upd.T, (sf, sb, lv)
+        sf, sb, lv, upd = build(Xb, g * w, h * w)
+        return pred + upd, (sf, sb, lv)
 
-    pred0 = jnp.full(n, base_score, dtype=jnp.float32)
-    final_pred, trees = jax.lax.scan(boost, pred0, None, length=num_trees)
-    return trees, final_pred
+    pred, trees = jax.lax.scan(boost, pred, None, length=chunk)
+    return trees, pred
 
 
 @partial(jax.jit, static_argnames=("max_depth",))
@@ -164,8 +210,7 @@ def _predict_binned_jit(Xb, split_feature, split_bin, leaf_value,
     n = Xb.shape[0]
     rows = jnp.arange(n)
 
-    def one_tree(pred, tree):
-        sf, sb, leaves = tree
+    def route(sf, sb, leaves):
         node = jnp.zeros(n, dtype=jnp.int32)
         for depth in range(max_depth):
             offset = 2 ** depth - 1
@@ -173,12 +218,45 @@ def _predict_binned_jit(Xb, split_feature, split_bin, leaf_value,
             thr = sb[offset + node]
             go_right = Xb[rows, feat] > thr
             node = node * 2 + go_right.astype(jnp.int32)
-        return pred + leaves[node], None
+        return leaves[node]
 
-    pred0 = jnp.zeros(n, dtype=jnp.float32)
+    def one_tree(pred, tree):
+        sf, sb, leaves = tree
+        if sf.ndim == 2:  # multiclass: [K, nodes] tables → [n, K] margins
+            return pred + jax.vmap(route)(sf, sb, leaves).T, None
+        return pred + route(sf, sb, leaves), None
+
+    if split_feature.ndim == 3:
+        pred0 = jnp.zeros((n, split_feature.shape[1]), dtype=jnp.float32)
+    else:
+        pred0 = jnp.zeros(n, dtype=jnp.float32)
     pred, _ = jax.lax.scan(one_tree, pred0,
                            (split_feature, split_bin, leaf_value))
     return pred
+
+
+def predict_binned(Xb, split_feature, split_bin, leaf_value,
+                   max_depth: int) -> np.ndarray:
+    return np.asarray(_predict_binned_jit(
+        jnp.asarray(Xb), jnp.asarray(split_feature), jnp.asarray(split_bin),
+        jnp.asarray(leaf_value), max_depth))
+
+
+def eval_metric(margin: np.ndarray, y: np.ndarray,
+                objective: str) -> Tuple[str, float]:
+    """The objective's default metric (xgboost naming)."""
+    if objective == "binary:logistic":
+        p = 1.0 / (1.0 + np.exp(-margin))
+        eps = 1e-7
+        return "logloss", float(-np.mean(
+            y * np.log(p + eps) + (1 - y) * np.log(1 - p + eps)))
+    if objective.startswith("multi:"):
+        e = np.exp(margin - margin.max(axis=1, keepdims=True))
+        p = e / e.sum(axis=1, keepdims=True)
+        rows = np.arange(len(y))
+        return "mlogloss", float(-np.mean(
+            np.log(p[rows, y.astype(np.int64)] + 1e-7)))
+    return "rmse", float(np.sqrt(np.mean((margin - y) ** 2)))
 
 
 def fit_gbdt(
@@ -192,31 +270,102 @@ def fit_gbdt(
     reg_lambda: float = 1.0,
     min_child_weight: float = 1.0,
     objective: str = "reg:squarederror",
+    num_class: Optional[int] = None,
+    sample_weight: Optional[np.ndarray] = None,
+    evals: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+    early_stopping_rounds: Optional[int] = None,
     bin_edges: Optional[np.ndarray] = None,
-) -> Tuple[GBDTModel, np.ndarray]:
-    """Fit a forest; returns (model, final training margins)."""
-    if objective not in ("reg:squarederror", "binary:logistic"):
-        raise ValueError(f"unsupported objective {objective!r}")
+) -> Tuple[GBDTModel, np.ndarray, Dict[str, List[float]]]:
+    """Fit a forest; returns (model, final train margins, evals_result).
+
+    ``evals_result`` holds per-round eval metrics (reference behavior: the
+    wrapped xgboost reports eval sets every boosting round,
+    xgboost/estimator.py:54-81); empty when no ``evals`` given. With
+    ``early_stopping_rounds`` the loop stops once the eval metric has not
+    improved for that many rounds and the forest is truncated to the best
+    iteration (recorded on ``model.best_iteration``).
+    """
+    known = ("reg:squarederror", "binary:logistic", "multi:softmax",
+             "multi:softprob")
+    if objective not in known:
+        raise ValueError(f"unsupported objective {objective!r}; have {known}")
+    multi = objective.startswith("multi:")
     X = np.asarray(X, dtype=np.float32)
     y = np.asarray(y, dtype=np.float32)
     if bin_edges is None:
         bin_edges = make_bins(X, num_bins)
     Xb = apply_bins(X, bin_edges)
+    w = (np.ones(len(y), np.float32) if sample_weight is None
+         else np.asarray(sample_weight, np.float32))
 
-    if objective == "binary:logistic":
-        p = float(np.clip(y.mean(), 1e-6, 1 - 1e-6))
-        base_score = float(np.log(p / (1 - p)))
+    if multi:
+        K = int(num_class or int(y.max()) + 1)
+        counts = np.bincount(y.astype(np.int64), minlength=K) + 1.0
+        base_score = np.log(counts / counts.sum()).astype(np.float32)
+        pred = jnp.broadcast_to(jnp.asarray(base_score),
+                                (len(y), K)).astype(jnp.float32)
+    elif objective == "binary:logistic":
+        p = float(np.clip(np.average(y, weights=w), 1e-6, 1 - 1e-6))
+        base_score = np.float32(np.log(p / (1 - p)))
+        pred = jnp.full(len(y), base_score, dtype=jnp.float32)
     else:
-        base_score = float(y.mean())
+        base_score = np.float32(np.average(y, weights=w))
+        pred = jnp.full(len(y), base_score, dtype=jnp.float32)
 
-    trees, final_pred = _fit_binned(
-        jnp.asarray(Xb), jnp.asarray(y), num_trees=num_trees,
-        max_depth=max_depth, num_bins=num_bins, learning_rate=learning_rate,
-        reg_lambda=reg_lambda, min_child_weight=min_child_weight,
-        base_score=base_score, objective=objective)
-    split_feature, split_bin, leaf_value = (np.asarray(t) for t in trees)
-    model = GBDTModel(split_feature=split_feature, split_bin=split_bin,
-                      leaf_value=leaf_value, bin_edges=bin_edges,
-                      base_score=base_score, max_depth=max_depth,
-                      objective=objective)
-    return model, np.asarray(final_pred)
+    kwargs = dict(max_depth=max_depth, num_bins=num_bins,
+                  learning_rate=learning_rate, reg_lambda=reg_lambda,
+                  min_child_weight=min_child_weight, objective=objective)
+    Xb_j, y_j, w_j = jnp.asarray(Xb), jnp.asarray(y), jnp.asarray(w)
+
+    evals_result: Dict[str, List[float]] = {}
+    if evals is None:
+        # fast path: one scan over all rounds, no host round-trips
+        trees, pred = _boost_chunk(Xb_j, y_j, w_j, pred, chunk=num_trees,
+                                   **kwargs)
+        tables = [np.asarray(t) for t in trees]
+        best_iteration = None
+    else:
+        eX, ey = evals
+        eXb = apply_bins(np.asarray(eX, np.float32), bin_edges)
+        ey = np.asarray(ey, np.float32)
+        if multi:
+            eval_margin = np.broadcast_to(base_score,
+                                          (len(ey), len(base_score))).copy()
+        else:
+            eval_margin = np.full(len(ey), base_score, np.float32)
+        parts: List[Tuple[np.ndarray, ...]] = []
+        metric_name = eval_metric(eval_margin, ey, objective)[0]
+        history: List[float] = []
+        best, best_round = np.inf, -1
+        for rnd in range(num_trees):
+            trees, pred = _boost_chunk(Xb_j, y_j, w_j, pred, chunk=1, **kwargs)
+            chunk_tables = tuple(np.asarray(t) for t in trees)
+            parts.append(chunk_tables)
+            eval_margin = eval_margin + predict_binned(
+                eXb, *chunk_tables, max_depth)
+            _, value = eval_metric(eval_margin, ey, objective)
+            history.append(value)
+            if value < best - 1e-12:
+                best, best_round = value, rnd
+            if (early_stopping_rounds is not None
+                    and rnd - best_round >= early_stopping_rounds):
+                break
+        evals_result = {f"eval_{metric_name}": history}
+        # a metric that never improves (NaN/inf) leaves best_round at -1:
+        # keep at least the first round rather than an empty forest
+        best_round = max(best_round, 0)
+        keep = (best_round + 1) if early_stopping_rounds is not None \
+            else len(parts)
+        tables = [np.concatenate([p[i] for p in parts[:keep]], axis=0)
+                  for i in range(3)]
+        best_iteration = best_round if early_stopping_rounds is not None \
+            else None
+        if keep < len(parts):  # truncated: train margins must match the kept forest
+            pred = base_score + predict_binned(Xb, *tables, max_depth)
+
+    model = GBDTModel(split_feature=tables[0], split_bin=tables[1],
+                      leaf_value=tables[2], bin_edges=bin_edges,
+                      base_score=np.asarray(base_score),
+                      max_depth=max_depth, objective=objective,
+                      best_iteration=best_iteration)
+    return model, np.asarray(pred), evals_result

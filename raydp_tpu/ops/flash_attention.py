@@ -192,9 +192,12 @@ def _use_pallas(t: int, d: int, blk_q: int, blk_k: int,
     if jax.default_backend() != "tpu":
         return False
     # block dims equal to the full array dim satisfy TPU tiling, so d needs no
-    # 128 alignment; sublane alignment of the q/k blocks is ensured by
-    # _fit_block keeping them powers of two ≥ 8 for typical inputs
-    return aligned and d % 8 == 0 and blk_q >= 8 and blk_k >= 8
+    # 128 alignment; q/k blocks must be sublane-aligned themselves —
+    # ``_fit_block`` caps blocks at t, which is not necessarily a multiple of
+    # 8 (e.g. t=20 → blk=20), so check it here rather than assume
+    return (aligned and d % 8 == 0
+            and blk_q >= 8 and blk_k >= 8
+            and blk_q % 8 == 0 and blk_k % 8 == 0)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))

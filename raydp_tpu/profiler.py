@@ -20,6 +20,7 @@ each epoch, so an unmodified user program already yields a usable timeline.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
@@ -28,7 +29,11 @@ import time
 from typing import Any, Dict, List, Optional
 
 _lock = threading.Lock()
-_spans: List[Dict[str, Any]] = []
+# bounded ring: long-lived actors trace every task (etl/executor.py), so an
+# unbounded list would grow for the life of the process; oldest spans drop
+MAX_SPANS = int(os.environ.get("RDT_PROFILER_MAX_SPANS", "100000"))
+_spans: "collections.deque[Dict[str, Any]]" = collections.deque(
+    maxlen=MAX_SPANS)
 _enabled = True
 
 

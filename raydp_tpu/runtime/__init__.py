@@ -21,10 +21,12 @@ from raydp_tpu.runtime.head import (
     runtime_initialized,
 )
 from raydp_tpu.runtime.actor import ActorHandle, actor_context, current_actor_context
+from raydp_tpu.runtime.cluster_resources import ClusterResources
 from raydp_tpu.runtime.object_store import ObjectRef, ObjectStoreClient
 from raydp_tpu.runtime.placement import PlacementGroup, PlacementStrategy
 
 __all__ = [
+    "ClusterResources",
     "RuntimeContext",
     "init_runtime",
     "shutdown_runtime",

@@ -138,6 +138,9 @@ class HeadService:
     def store_arena_stats(self, *a):
         return self._rt.store_server.arena_stats(*a)
 
+    def store_arena_reap(self, *a):
+        return self._rt.store_server.arena_reap(*a)
+
     # ---- actor lifecycle ----------------------------------------------------
     def fetch_actor_spec(self, actor_id: str) -> Dict[str, Any]:
         rec = self._rt.record(actor_id)

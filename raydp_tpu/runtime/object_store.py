@@ -87,6 +87,15 @@ class ObjectStoreServer:
     arena is present the server also runs its free path (``rdt_free``).
     """
 
+    #: seconds an arena-resident payload stays mapped after its free. Readers
+    #: hold *borrowed* zero-copy views (``get_buffer`` / ``get(zero_copy=True)``,
+    #: e.g. the device feed's epoch-long block tables) and frees can arrive
+    #: asynchronously (owner-death sweeps, executor-shrink); an immediate
+    #: ``rdt_free`` would let a writer recycle bytes under a live view. The
+    #: per-object-segment mode never had this hazard (unlink preserves mapped
+    #: contents), so arena mode defers reclamation for a grace period instead.
+    ARENA_FREE_GRACE_S = float(os.environ.get("RDT_ARENA_FREE_GRACE_S", "60"))
+
     def __init__(self, session_id: str, arena=None):
         self.session_id = session_id
         self._arena = arena
@@ -95,6 +104,7 @@ class ObjectStoreServer:
         self._arena_lock = threading.Lock()
         self._lock = threading.Lock()
         self._table: Dict[str, _Entry] = {}
+        self._deferred: List[Tuple[float, int]] = []  # (due time, offset)
 
     # -- arena ----------------------------------------------------------------
     def arena_info(self) -> Optional[Dict[str, Any]]:
@@ -106,6 +116,12 @@ class ObjectStoreServer:
         with self._arena_lock:
             return None if self._arena is None else self._arena.stats()
 
+    def arena_reap(self) -> bool:
+        """Free deferred allocations whose grace elapsed (writers call this
+        when the arena looks full before falling back to segments)."""
+        self._reap_deferred()
+        return True
+
     # -- write path -----------------------------------------------------------
     def seal(self, object_id: str, segment: str, size: int, kind: str,
              owner: str, offset: int = -1) -> None:
@@ -113,6 +129,7 @@ class ObjectStoreServer:
             if object_id in self._table:
                 raise KeyError(f"object {object_id} already sealed")
             self._table[object_id] = _Entry(segment, size, kind, owner, offset)
+        self._reap_deferred()
 
     # -- read path ------------------------------------------------------------
     def lookup(self, object_id: str) -> Tuple[str, int, str, int]:
@@ -144,11 +161,32 @@ class ObjectStoreServer:
 
     def _release_payload(self, e: _Entry) -> None:
         if e.offset >= 0:
+            import time as _time
             with self._arena_lock:
                 if self._arena is not None:
-                    self._arena.free(e.offset)
+                    self._deferred.append(
+                        (_time.monotonic() + self.ARENA_FREE_GRACE_S,
+                         e.offset))
+            self._reap_deferred()
         else:
             _unlink_segment(e.segment)
+
+    def _reap_deferred(self, everything: bool = False) -> None:
+        """Free arena offsets whose grace period elapsed (activity-driven:
+        called on frees and seals; shutdown reaps everything)."""
+        import time as _time
+        now = _time.monotonic()
+        with self._arena_lock:
+            if self._arena is None:
+                self._deferred.clear()
+                return
+            keep = []
+            for due, offset in self._deferred:
+                if everything or due <= now:
+                    self._arena.free(offset)
+                else:
+                    keep.append((due, offset))
+            self._deferred = keep
 
     def transfer_ownership(self, object_ids: List[str], new_owner: str) -> int:
         with self._lock:
@@ -189,6 +227,7 @@ class ObjectStoreServer:
         for e in entries:
             if e.offset < 0:
                 _unlink_segment(e.segment)
+        self._reap_deferred(everything=True)
         with self._arena_lock:
             if self._arena is not None:
                 self._arena.close()
@@ -273,15 +312,31 @@ class ObjectStoreClient:
         arena = self._write_arena()
         if arena is not None:
             offset = arena.alloc(size)
+            if offset is None:
+                # expired deferred frees may be holding the space: reap on
+                # the server and retry once before the slow per-segment path
+                try:
+                    self._server.arena_reap()
+                    offset = arena.alloc(size)
+                except Exception:
+                    offset = None
             if offset is not None:
-                if size:
-                    view = arena.view(offset, size)
-                    if isinstance(data, memoryview):
-                        view[:] = data.cast("B")
-                    else:
-                        view[:] = data
-                self._server.seal(object_id, arena.segment, size, kind,
-                                  owner or self.default_owner, offset)
+                try:
+                    if size:
+                        view = arena.view(offset, size)
+                        if isinstance(data, memoryview):
+                            view[:] = data.cast("B")
+                        else:
+                            view[:] = data
+                    self._server.seal(object_id, arena.segment, size, kind,
+                                      owner or self.default_owner, offset)
+                except BaseException:
+                    # unsealed allocation would leak until session end
+                    try:
+                        arena.free(offset)
+                    except Exception:
+                        pass
+                    raise
                 return ObjectRef(id=object_id, size=size, kind=kind)
             # arena full: fall through to a dedicated segment
         seg_name = self._segment_name(object_id)

@@ -12,9 +12,13 @@ Parity map (reference xgboost/estimator.py):
   ``fit_on_frame`` / ``get_model`` below.
 
 Accepted ``params`` keys follow xgboost naming: ``objective``
-(``reg:squarederror`` | ``binary:logistic``), ``max_depth``, ``eta`` /
+(``reg:squarederror`` | ``binary:logistic`` | ``multi:softmax`` |
+``multi:softprob``), ``num_class``, ``max_depth``, ``eta`` /
 ``learning_rate``, ``lambda`` / ``reg_lambda``, ``min_child_weight``,
-``max_bin``.
+``max_bin``. Eval sets are scored every boosting round
+(``result.evals_result``, parity: xgboost per-round eval reporting) and
+``early_stopping_rounds`` stops and truncates to the best iteration;
+``weight_column`` supplies per-row instance weights.
 """
 
 from __future__ import annotations
@@ -41,9 +45,12 @@ class GBDTEstimator(EstimatorInterface, FrameEstimatorInterface):
         label_column: Optional[str] = None,
         num_boost_round: int = 100,
         checkpoint_dir: Optional[str] = None,
+        early_stopping_rounds: Optional[int] = None,
+        weight_column: Optional[str] = None,
     ):
         params = dict(params or {})
         self.objective = params.pop("objective", "reg:squarederror")
+        self.num_class = params.pop("num_class", None)
         self.max_depth = int(params.pop("max_depth", 6))
         self.learning_rate = float(params.pop(
             "eta", params.pop("learning_rate", 0.3)))
@@ -51,17 +58,22 @@ class GBDTEstimator(EstimatorInterface, FrameEstimatorInterface):
             "lambda", params.pop("reg_lambda", 1.0)))
         self.min_child_weight = float(params.pop("min_child_weight", 1.0))
         self.num_bins = int(params.pop("max_bin", 256))
+        if "early_stopping_rounds" in params:
+            early_stopping_rounds = params.pop("early_stopping_rounds")
         if params:
             logger.warning("ignoring unsupported params: %s", sorted(params))
         self.feature_columns = list(feature_columns or [])
         self.label_column = label_column
         self.num_boost_round = num_boost_round
         self.checkpoint_dir = checkpoint_dir
+        self.early_stopping_rounds = early_stopping_rounds
+        self.weight_column = weight_column
         self._model = None
         self._result: Optional[TrainingResult] = None
+        self.evals_result: Dict = {}
 
     # ------------------------------------------------------------------ data
-    def _materialize(self, ds):
+    def _materialize(self, ds, with_weight: bool = False):
         if ds is None:
             return None
         if not self.feature_columns or self.label_column is None:
@@ -72,33 +84,45 @@ class GBDTEstimator(EstimatorInterface, FrameEstimatorInterface):
                       for c in self.feature_columns], axis=1)
         y = (table.column(self.label_column).to_numpy(zero_copy_only=False)
              .astype(np.float32, copy=False))
-        return X, y
+        if with_weight and self.weight_column is not None:
+            w = (table.column(self.weight_column)
+                 .to_numpy(zero_copy_only=False).astype(np.float32, copy=False))
+            return X, y, w
+        return (X, y, None) if with_weight else (X, y)
 
     def _metrics_from_margin(self, margin, y, prefix: str) -> Dict[str, float]:
+        from raydp_tpu.models.gbdt import eval_metric
+
+        name, value = eval_metric(margin, y, self.objective)
+        out = {f"{prefix}_{name}": value}
         if self.objective == "binary:logistic":
             p = 1.0 / (1.0 + np.exp(-margin))
-            eps = 1e-7
-            ll = float(-np.mean(y * np.log(p + eps)
-                                + (1 - y) * np.log(1 - p + eps)))
-            return {f"{prefix}_logloss": ll,
-                    f"{prefix}_error": float(((p > 0.5) != (y > 0.5)).mean())}
-        return {f"{prefix}_rmse": float(np.sqrt(np.mean((margin - y) ** 2)))}
+            out[f"{prefix}_error"] = float(((p > 0.5) != (y > 0.5)).mean())
+        elif self.objective.startswith("multi:"):
+            out[f"{prefix}_merror"] = float(
+                (margin.argmax(axis=1) != y.astype(np.int64)).mean())
+        return out
 
     # ------------------------------------------------------------------- fit
     def fit(self, train_ds, evaluate_ds=None, max_retries: int = 0
             ) -> TrainingResult:
         from raydp_tpu.models.gbdt import fit_gbdt
 
-        X, y = self._materialize(train_ds)
+        X, y, w = self._materialize(train_ds, with_weight=True)
         evals = self._materialize(evaluate_ds)
 
-        model, train_margin = fit_gbdt(
+        model, train_margin, evals_result = fit_gbdt(
             X, y, num_trees=self.num_boost_round, max_depth=self.max_depth,
             num_bins=self.num_bins, learning_rate=self.learning_rate,
             reg_lambda=self.reg_lambda, min_child_weight=self.min_child_weight,
-            objective=self.objective)
+            objective=self.objective, num_class=self.num_class,
+            sample_weight=w, evals=evals,
+            early_stopping_rounds=self.early_stopping_rounds)
+        self.evals_result = evals_result
 
         report = {"num_trees": model.num_trees}
+        if model.best_iteration is not None:
+            report["best_iteration"] = model.best_iteration
         report.update(self._metrics_from_margin(train_margin, y, "train"))
         if evals is not None:
             eX, ey = evals

@@ -26,7 +26,7 @@ def test_regression_quality():
     X = rng.rand(4000, 6).astype(np.float32)
     y = (2 * X[:, 0] - X[:, 1] ** 2 + np.sin(4 * X[:, 2])
          + 0.05 * rng.randn(4000)).astype(np.float32)
-    model, _ = fit_gbdt(X, y, num_trees=40, max_depth=5, num_bins=64,
+    model, _, _ = fit_gbdt(X, y, num_trees=40, max_depth=5, num_bins=64,
                         learning_rate=0.2)
     rmse = float(np.sqrt(np.mean((model.predict(X) - y) ** 2)))
     base = float(y.std())
@@ -37,7 +37,7 @@ def test_classification_quality():
     rng = np.random.RandomState(2)
     X = rng.rand(3000, 4).astype(np.float32)
     y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
-    model, _ = fit_gbdt(X, y, num_trees=30, max_depth=4, num_bins=64,
+    model, _, _ = fit_gbdt(X, y, num_trees=30, max_depth=4, num_bins=64,
                         learning_rate=0.3, objective="binary:logistic")
     p = model.predict(X)
     assert ((p > 0.5) == (y > 0.5)).mean() > 0.97
@@ -83,3 +83,97 @@ def test_estimator_fit_on_frame(session):
     # xgboost/estimator.py:60-68)
     loaded = GBDTEstimator.load_model(result.checkpoint_dir)
     np.testing.assert_allclose(loaded.predict(x[:5]), preds, rtol=1e-6)
+
+
+def test_multiclass_matches_sklearn_quality():
+    """multi:softprob on 4-class blobs: accuracy within 3 points of sklearn's
+    GradientBoostingClassifier on the same data (VERDICT #8 done-bar)."""
+    from sklearn.datasets import make_blobs
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    X, y = make_blobs(n_samples=3000, centers=4, n_features=5,
+                      cluster_std=3.0, random_state=3)
+    X = X.astype(np.float32)
+    cut = 2400
+    model, _, _ = fit_gbdt(X[:cut], y[:cut].astype(np.float32),
+                           num_trees=40, max_depth=4, num_bins=64,
+                           learning_rate=0.2, objective="multi:softprob")
+    probs = model.predict(X[cut:])
+    assert probs.shape == (600, 4)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+    acc = float((probs.argmax(axis=1) == y[cut:]).mean())
+
+    sk = GradientBoostingClassifier(n_estimators=40, max_depth=4,
+                                    learning_rate=0.2, random_state=0)
+    sk.fit(X[:cut], y[:cut])
+    sk_acc = float(sk.score(X[cut:], y[cut:]))
+    assert acc >= sk_acc - 0.03, (acc, sk_acc)
+
+    # multi:softmax returns class ids directly
+    model2, _, _ = fit_gbdt(X[:cut], y[:cut].astype(np.float32),
+                            num_trees=10, max_depth=4, num_bins=64,
+                            objective="multi:softmax")
+    pred = model2.predict(X[cut:])
+    assert set(np.unique(pred)).issubset({0.0, 1.0, 2.0, 3.0})
+
+
+def test_per_round_eval_and_early_stopping():
+    rng = np.random.RandomState(5)
+    X = rng.rand(2000, 5).astype(np.float32)
+    y = (X[:, 0] + 0.3 * rng.randn(2000)).astype(np.float32)  # noisy target
+    cut = 1000
+    model, _, evals = fit_gbdt(
+        X[:cut], y[:cut], num_trees=200, max_depth=6, num_bins=64,
+        learning_rate=0.5, evals=(X[cut:], y[cut:]),
+        early_stopping_rounds=5)
+    history = evals["eval_rmse"]
+    # stopped early: deep greedy trees at lr=0.5 overfit noise quickly
+    assert len(history) < 200
+    assert model.best_iteration == int(np.argmin(history))
+    # the forest is truncated to the best iteration
+    assert model.num_trees == model.best_iteration + 1
+    # per-round reporting really is per round
+    assert len(history) == model.best_iteration + 1 + 5
+
+
+def test_instance_weights_shift_the_fit():
+    """Weighting duplicates: weight-2 fit == duplicated-row fit."""
+    rng = np.random.RandomState(7)
+    X = rng.rand(600, 3).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    w = np.where(y > 0, 2.0, 1.0).astype(np.float32)
+
+    edges = make_bins(X, 32)
+    m_w, _, _ = fit_gbdt(X, y, num_trees=10, max_depth=3, num_bins=32,
+                         objective="binary:logistic", sample_weight=w,
+                         bin_edges=edges)
+    Xd = np.concatenate([X, X[y > 0]], axis=0)
+    yd = np.concatenate([y, y[y > 0]], axis=0)
+    m_d, _, _ = fit_gbdt(Xd, yd, num_trees=10, max_depth=3, num_bins=32,
+                         objective="binary:logistic", bin_edges=edges)
+    np.testing.assert_allclose(m_w.predict(X), m_d.predict(X),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_estimator_multiclass_early_stop(session):
+    from raydp_tpu.train import GBDTEstimator
+
+    rng = np.random.RandomState(11)
+    n = 1500
+    X = rng.rand(n, 4)
+    label = (X[:, 0] * 3).astype(np.int64).clip(0, 2)
+    pdf = pd.DataFrame({f"f{i}": X[:, i] for i in range(4)})
+    pdf["y"] = label.astype(np.float64)
+    df = session.createDataFrame(pdf, num_partitions=3)
+    train_df, eval_df = df.randomSplit([0.8, 0.2], seed=0)
+
+    est = GBDTEstimator(
+        params={"objective": "multi:softprob", "num_class": 3,
+                "max_depth": 3, "eta": 0.3},
+        feature_columns=[f"f{i}" for i in range(4)],
+        label_column="y", num_boost_round=60, early_stopping_rounds=8)
+    result = est.fit_on_frame(train_df, eval_df)
+    report = result.history[-1]
+    assert report["eval_merror"] < 0.1
+    assert "eval_mlogloss" in est.evals_result
+    assert len(est.evals_result["eval_mlogloss"]) <= 60

@@ -151,7 +151,13 @@ def test_store_uses_arena(runtime):
 
     before = runtime.store_server.arena_stats()["bytes_in_use"]
     assert before > 0
+    view_table = client.get(ref, zero_copy=True)  # borrowed view of the arena
     client.free([ref])
+    # reclamation is deferred for a grace period so borrowed zero-copy views
+    # (device feed, lineage recovery) can't be overwritten under the reader
+    assert runtime.store_server.arena_stats()["bytes_in_use"] == before
+    assert view_table.equals(table)
+    runtime.store_server._reap_deferred(everything=True)
     after = runtime.store_server.arena_stats()["bytes_in_use"]
     assert after < before
 

@@ -182,3 +182,22 @@ def test_remove_node_respawns_actor(runtime_3nodes):
             time.sleep(0.2)
     assert ok, "actor did not come back after node removal"
     assert rt.record(h.actor_id).node_id != first_node
+
+
+def test_cluster_resources_satisfy(runtime_3nodes):
+    from raydp_tpu.runtime import ClusterResources
+
+    cr = ClusterResources(runtime_3nodes)
+    cr.refresh_interval = 0.0  # no caching inside the test
+    assert cr.total_alive_nodes() == 3
+    # every node has 4 CPUs; the num_cpus alias maps to CPU
+    assert len(cr.satisfy({"num_cpus": 4})) == 3
+    assert cr.satisfy({"CPU": 5}) == []
+    # only one node carries the custom accelerator resource
+    assert len(cr.satisfy({"accel": 1.0})) == 1
+    # allocation shrinks availability: take 3 CPUs on some node
+    node_id = runtime_3nodes.resource_manager.allocate({"CPU": 3.0})
+    assert node_id is not None
+    assert len(cr.satisfy({"num_cpus": 4})) == 2
+    labels = cr.satisfy({"CPU": 1})
+    assert all(lbl.startswith("node:") for lbl in labels)

@@ -1,0 +1,63 @@
+"""rdt-submit CLI (parity: bin/raydp-submit — conf handoff into the session,
+exit-code propagation)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+
+def _run(args, cwd):
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "raydp_tpu.cli.submit"] + args,
+        cwd=cwd, env=env, capture_output=True, text=True, timeout=300)
+
+
+def test_submit_conf_handoff(tmp_path):
+    script = tmp_path / "job.py"
+    script.write_text(textwrap.dedent("""
+        import raydp_tpu
+        session = raydp_tpu.init("submitted")   # all defaults in code
+        print("EXECUTORS=%d" % len(session.executors))
+        print("CONF=%s" % session.config.get("raydp.tpu.custom.key"))
+        raydp_tpu.stop()
+    """))
+    proc = _run(["--num-executors", "2",
+                 "--conf", "raydp.tpu.custom.key=hello",
+                 str(script)], cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "EXECUTORS=2" in proc.stdout
+    assert "CONF=hello" in proc.stdout
+
+
+def test_submit_explicit_args_win(tmp_path):
+    script = tmp_path / "job.py"
+    script.write_text(textwrap.dedent("""
+        import raydp_tpu
+        session = raydp_tpu.init("submitted", num_executors=1)
+        print("EXECUTORS=%d" % len(session.executors))
+        raydp_tpu.stop()
+    """))
+    proc = _run(["--num-executors", "3", str(script)], cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "EXECUTORS=1" in proc.stdout
+
+
+def test_submit_exit_code_and_args_passthrough(tmp_path):
+    script = tmp_path / "job.py"
+    script.write_text(textwrap.dedent("""
+        import sys
+        assert sys.argv[1:] == ["--flag", "value"]
+        sys.exit(7)
+    """))
+    proc = _run([str(script), "--flag", "value"], cwd=str(tmp_path))
+    assert proc.returncode == 7
+
+
+def test_submit_missing_script(tmp_path):
+    proc = _run(["/nonexistent/script.py"], cwd=str(tmp_path))
+    assert proc.returncode != 0
+    assert "not found" in proc.stderr
