@@ -25,6 +25,7 @@ import os
 import sys
 import tempfile
 import time
+from typing import Optional
 
 # Self-measured reference numbers (benchmarks/reference_nyctaxi_torch.py,
 # 400k rows, torch 2.13 CPU, 2026-07-29; see BASELINE.md):
@@ -41,6 +42,29 @@ SEQ_LEN = int(os.environ.get("BENCH_SEQ_LEN", "8192"))
 def _num_chips() -> int:
     import jax
     return max(1, len(jax.devices()))
+
+
+def _probe_devices(timeout_s: Optional[float] = None) -> bool:
+    """Can a fresh process enumerate devices? Run in a subprocess so a hung
+    init cannot take this process with it. Note: the probe itself briefly
+    claims the chip, so never run bench concurrently with another TPU job
+    (which would be wrong anyway — one process owns the chip). Tune the
+    deadline with BENCH_TPU_PROBE_S.
+    """
+    import subprocess
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("BENCH_TPU_PROBE_S", "300"))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        return proc.returncode == 0 and "ok" in (out or "")
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        # no further wait: a child stuck in an uninterruptible device ioctl
+        # is unreapable, and waiting on it would recreate the hang here
+        return False
 
 
 def _steady(history):
@@ -259,11 +283,21 @@ def main():
     sys.path.insert(0, os.path.join(here, "examples"))
     sys.path.insert(0, here)
 
+    platform = "default"
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         # in-process override is the only platform selection a startup hook
         # cannot trump (see .claude/skills/verify/SKILL.md gotchas)
         import jax
         jax.config.update("jax_platforms", "cpu")
+        platform = "cpu(forced)"
+    elif not _probe_devices():
+        # a wedged TPU tunnel blocks device init forever; a CPU run with an
+        # explicit marker beats a bench that never reports
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu(tpu-unavailable-fallback)"
+        print("# TPU device init timed out; falling back to CPU",
+              file=sys.stderr)
 
     selected = [c.strip() for c in os.environ.get(
         "BENCH_CONFIGS", "nyctaxi,dlrm,keras,transformer").split(",")
@@ -287,6 +321,7 @@ def main():
     out = {
         "metric": "nyctaxi_e2e_train_samples_per_sec_per_chip",
         "unit": "samples/s/chip",
+        "platform": platform,
         "baseline_note": "self-measured reference workload, torch CPU "
                          f"batch 8192 ({REF_NYCTAXI_B8192:.0f} samples/s; "
                          f"batch-64-as-shipped: {REF_NYCTAXI_B64:.0f})",

@@ -18,6 +18,7 @@ of SURVEY.md §2.5's "TPU-native equivalent".
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from dataclasses import dataclass, field
@@ -64,7 +65,14 @@ def _as_numpy(table: pa.Table, columns: Sequence[str], dtype) -> np.ndarray:
 
 
 class HostBatchIterator:
-    """Yields host-side numpy batch dicts from a dataset (or one shard of it)."""
+    """Yields host-side numpy batch dicts from a dataset (or one shard of it).
+
+    Decoded blocks are cached across epochs (``cache_decoded``, on by
+    default, bounded by ``RDT_FEED_CACHE_MB``): Arrow→numpy decode + dtype
+    cast is the dominant host cost of an epoch once the train step is fast,
+    and multi-epoch training re-reads the same immutable blocks. Per-epoch
+    shuffling permutes indices over the cached arrays instead of re-decoding.
+    """
 
     def __init__(
         self,
@@ -75,6 +83,8 @@ class HostBatchIterator:
         shuffle: bool = True,
         seed: int = 0,
         drop_remainder: bool = True,
+        cache_decoded: bool = True,
+        cache_cap_bytes: Optional[int] = None,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -83,12 +93,51 @@ class HostBatchIterator:
         self.shuffle = shuffle
         self.seed = seed
         self.drop_remainder = drop_remainder
+        self.cache_decoded = cache_decoded
+        # per-iterator budget (train and eval feeds each get their own); env
+        # read at construction so callers can tune it after import
+        self.cache_cap_bytes = cache_cap_bytes if cache_cap_bytes is not None \
+            else int(float(os.environ.get("RDT_FEED_CACHE_MB", "2048"))
+                     * (1 << 20))
+        self._decoded: Dict[int, Dict[str, np.ndarray]] = {}
+        self._cache_bytes = 0
 
     def _parts(self) -> List[Tuple[int, int, int]]:
         if self.shard is not None:
             return list(self.shard.parts)
         return [(i, 0, self.dataset._blocks[i].num_rows)
                 for i in range(self.dataset.num_blocks())]
+
+    def _block_rows(self, block_idx: int) -> int:
+        return self.dataset._blocks[block_idx].num_rows
+
+    def _decode_block(self, block_idx: int) -> Dict[str, np.ndarray]:
+        """Decode (and maybe cache) ALL rows of a block."""
+        cached = self._decoded.get(block_idx)
+        if cached is not None:
+            return cached
+        table = self.dataset.get_block(block_idx, zero_copy=True)
+        arrays = {name: _as_numpy(table, cols, dt)
+                  for name, (cols, dt) in self.columns.items()}
+        if self.cache_decoded:
+            size = sum(a.nbytes for a in arrays.values())
+            if self._cache_bytes + size <= self.cache_cap_bytes:
+                # own the bytes: a zero-copy view into the store must not be
+                # cached past this iteration (the block could be freed)
+                arrays = {n: (a if a.flags["OWNDATA"] else a.copy())
+                          for n, a in arrays.items()}
+                self._decoded[block_idx] = arrays
+                self._cache_bytes += size
+        return arrays
+
+    def _decode_slice(self, block_idx: int, off: int,
+                      length: int) -> Dict[str, np.ndarray]:
+        """Decode just ``[off, off+length)`` — used for partial shard parts
+        so a rank neither decodes nor budgets rows it never reads."""
+        table = self.dataset.get_block(block_idx,
+                                       zero_copy=True).slice(off, length)
+        return {name: _as_numpy(table, cols, dt)
+                for name, (cols, dt) in self.columns.items()}
 
     def __iter__(self):
         rng = np.random.RandomState(self.seed)
@@ -98,14 +147,22 @@ class HostBatchIterator:
         buffers: Dict[str, List[np.ndarray]] = {n: [] for n in self.columns}
         buffered = 0
         for block_idx, off, length in parts:
-            table = self.dataset.get_block(block_idx,
-                                           zero_copy=True).slice(off, length)
-            if self.shuffle and table.num_rows > 1:
-                perm = rng.permutation(table.num_rows)
-                table = table.take(pa.array(perm))
-            for name, (cols, dt) in self.columns.items():
-                buffers[name].append(_as_numpy(table, cols, dt))
-            buffered += table.num_rows
+            full_block = off == 0 and length == self._block_rows(block_idx)
+            if full_block or block_idx in self._decoded:
+                arrays = self._decode_block(block_idx)
+                if self.shuffle and length > 1:
+                    idx = off + rng.permutation(length)
+                    sel = {n: a[idx] for n, a in arrays.items()}
+                else:
+                    sel = {n: a[off:off + length] for n, a in arrays.items()}
+            else:
+                sel = self._decode_slice(block_idx, off, length)
+                if self.shuffle and length > 1:
+                    idx = rng.permutation(length)
+                    sel = {n: a[idx] for n, a in sel.items()}
+            for name in self.columns:
+                buffers[name].append(sel[name])
+            buffered += length
             while buffered >= self.batch_size:
                 batch, buffers, buffered = self._cut_batch(buffers, buffered)
                 yield batch
