@@ -186,20 +186,16 @@ def bench_keras() -> dict:
                 keras.layers.Dense(1),
             ])
 
-        epochs = max(2, EPOCHS // 2)
+        epochs = max(3, EPOCHS // 2 + 1)
         est = KerasEstimator(
             model_builder=build, optimizer="adam", loss="mse",
             feature_columns=features, label_column=LABEL,
             batch_size=min(BATCH, 4096), num_epochs=epochs,
             data_parallel=_num_chips() > 1)
-        rows = data.count()
         t0 = time.perf_counter()
         result = est.fit_on_frame(data)
         wall = time.perf_counter() - t0
-        # keras's History carries no timings: report whole-fit throughput
-        # (includes the one-off XLA compile, so it is a lower bound)
-        sps = rows * epochs / wall if wall > 0 else 0.0
-        return {"samples_per_s_per_chip_incl_compile": sps / _num_chips(),
+        return {"samples_per_s_per_chip": _steady(result.history) / _num_chips(),
                 "final_loss": result.history[-1].get("loss"),
                 "wall_s": round(wall, 1)}
     finally:
@@ -231,7 +227,7 @@ def bench_transformer() -> dict:
     from raydp_tpu.models import TransformerLM, lm_loss
 
     dim, heads, layers, vocab = 512, 8, 4, 32768
-    B, T = 1, SEQ_LEN
+    B, T = int(os.environ.get("BENCH_LM_BATCH", "2")), SEQ_LEN
     steps = int(os.environ.get("BENCH_LM_STEPS", "8"))
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, vocab, size=(B, T)), jnp.int32)
@@ -264,8 +260,11 @@ def bench_transformer() -> dict:
 
         n_params = sum(int(np.prod(p.shape))
                        for p in jax.tree.leaves(params))
-        # train FLOPs/token ≈ 6·P (matmuls) + 6·L·d·T (causal attention)
-        flops_per_tok = 6 * n_params + 6 * layers * dim * T
+        # train FLOPs/token ≈ 6·(P − embed) + 6·L·d·T: the embedding table is
+        # a gather, not a matmul (the lm_head, same size, IS one and stays in
+        # P); attention is causal, hence T/2 effective keys per query
+        matmul_params = n_params - vocab * dim
+        flops_per_tok = 6 * matmul_params + 6 * layers * dim * T
         peak = _peak_flops(jax.devices()[0])
         entry = {"tokens_per_s": round(tok_s, 1),
                  "loss": round(float(loss), 3)}

@@ -101,15 +101,20 @@ class HostBatchIterator:
                      * (1 << 20))
         self._decoded: Dict[int, Dict[str, np.ndarray]] = {}
         self._cache_bytes = 0
+        self._sizes: Optional[List[int]] = None
+
+    def _block_sizes(self) -> List[int]:
+        if self._sizes is None:
+            self._sizes = list(self.dataset.block_sizes())
+        return self._sizes
 
     def _parts(self) -> List[Tuple[int, int, int]]:
         if self.shard is not None:
             return list(self.shard.parts)
-        return [(i, 0, self.dataset._blocks[i].num_rows)
-                for i in range(self.dataset.num_blocks())]
+        return [(i, 0, n) for i, n in enumerate(self._block_sizes())]
 
     def _block_rows(self, block_idx: int) -> int:
-        return self.dataset._blocks[block_idx].num_rows
+        return self._block_sizes()[block_idx]
 
     def _decode_block(self, block_idx: int) -> Dict[str, np.ndarray]:
         """Decode (and maybe cache) ALL rows of a block."""
@@ -126,6 +131,11 @@ class HostBatchIterator:
                 # cached past this iteration (the block could be freed)
                 arrays = {n: (a if a.flags["OWNDATA"] else a.copy())
                           for n, a in arrays.items()}
+                for a in arrays.values():
+                    # batches served from the cache are views; freezing the
+                    # cache turns an in-place consumer mutation (which would
+                    # silently poison later epochs) into a loud error
+                    a.setflags(write=False)
                 self._decoded[block_idx] = arrays
                 self._cache_bytes += size
         return arrays

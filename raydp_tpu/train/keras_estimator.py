@@ -182,6 +182,37 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                     os.path.join(ckpt_dir, "model.keras"),
                     save_best_only=False))
 
+            # per-epoch wall times (keras's History has none), so throughput
+            # can be reported steady-state like the FlaxEstimator's
+            import time as _time
+
+            epoch_times: list = []
+
+            class _EpochTimer(keras.callbacks.Callback):
+                """Times the TRAIN portion of each epoch (clock stops when
+                validation starts), matching FlaxEstimator's train-only
+                ``samples_per_s`` so bench comparisons are like-for-like."""
+
+                def on_train_begin(self, logs=None):
+                    epoch_times.clear()  # retries restart the clock
+
+                def on_epoch_begin(self, epoch, logs=None):
+                    self._t0 = _time.perf_counter()
+                    self._train_end = None
+
+                def on_test_begin(self, logs=None):
+                    if getattr(self, "_t0", None) is not None \
+                            and self._train_end is None:
+                        self._train_end = _time.perf_counter()
+
+                def on_epoch_end(self, epoch, logs=None):
+                    end = self._train_end or _time.perf_counter()
+                    epoch_times.append(end - self._t0)
+
+            # first in the list: later callbacks' epoch-end work (e.g. the
+            # ModelCheckpoint save) must not land inside the timed window
+            callbacks.insert(0, _EpochTimer())
+
             attempt = 0
             while True:
                 try:
@@ -220,10 +251,15 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                                 self._optimizer_spec),
                             loss=self._loss, metrics=list(self._metrics))
 
-            history = [
-                {"epoch": i, **{k: float(v[i]) for k, v in hist.history.items()}}
-                for i in range(len(hist.epoch))
-            ]
+            n_rows = int(np.asarray(y).shape[0])
+            history = []
+            for i in range(len(hist.epoch)):
+                row = {"epoch": i,
+                       **{k: float(v[i]) for k, v in hist.history.items()}}
+                if i < len(epoch_times) and epoch_times[i] > 0:
+                    row["epoch_time_s"] = epoch_times[i]
+                    row["samples_per_s"] = n_rows / epoch_times[i]
+                history.append(row)
             self._trained_model = model
             self._result = TrainingResult(state=model, history=history,
                                           checkpoint_dir=ckpt_dir)

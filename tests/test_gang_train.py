@@ -110,3 +110,36 @@ def test_gang_rejects_indivisible_batch():
     with pytest.raises(ValueError, match="divisible"):
         GangShardIterator(_FakeDs(), global_batch=10, world_size=3, rank=0,
                           columns={"x": ("x", np.float32)})
+
+
+def test_gang_iterator_covers_rows_exactly_once():
+    """_runs boundary math: every global batch row is read exactly once per
+    epoch, across uneven block boundaries and both ranks."""
+    from raydp_tpu.data.feed import GangShardIterator
+
+    sizes = [7, 13, 5, 22, 1]          # awkward block sizes, total 48
+    rows = np.arange(48, dtype=np.float64)
+    blocks = []
+    start = 0
+    for s in sizes:
+        import pyarrow as pa
+        blocks.append(pa.table({"x": rows[start:start + s]}))
+        start += s
+
+    class _Ds:
+        def block_sizes(self):
+            return sizes
+
+        def get_block(self, i, zero_copy=False):
+            return blocks[i]
+
+    got = []
+    for rank in (0, 1):
+        it = GangShardIterator(_Ds(), global_batch=16, world_size=2,
+                               rank=rank, columns={"x": ("x", np.float64)})
+        assert len(it) == 3
+        for batch in it:
+            assert batch["x"].shape == (8,)
+            got.extend(batch["x"].tolist())
+    # 3 global batches x 16 rows = rows 0..47 exactly once across both ranks
+    assert sorted(got) == list(range(48))
