@@ -122,8 +122,17 @@ class ActorHandle:
         with self._lock:
             if self._client is not None and not refresh and not self._client._closed:
                 return self._client
-            address = self._head_client().call("get_actor_address", self.actor_id,
-                                              timeout=60.0)
+            try:
+                address = self._head_client().call(
+                    "get_actor_address", self.actor_id, timeout=60.0)
+            except ConnectionLost:
+                # transient head-connection reset: retry once, fresh socket
+                # (lock already held — do not route through _head_call)
+                if self._head is not None:
+                    self._head.close()
+                    self._head = None
+                address = self._head_client().call(
+                    "get_actor_address", self.actor_id, timeout=60.0)
             if address is None:
                 raise ConnectionLost(
                     f"actor {self.name or self.actor_id} is not alive")
@@ -152,17 +161,45 @@ class ActorHandle:
             raise AttributeError(item)
         return ActorMethod(self, item)
 
+    def _head_call(self, method: str, *args,
+                   timeout: Optional[float] = None):
+        """Head calls from handles are idempotent registry reads/commands; a
+        transient connection reset (rare but observed under churn) is retried
+        once over a fresh connection instead of failing the caller."""
+        try:
+            return self._head_client().call(method, *args, timeout=timeout)
+        except ConnectionLost:
+            with self._lock:
+                if self._head is not None:
+                    self._head.close()
+                    self._head = None
+            return self._head_client().call(method, *args, timeout=timeout)
+
     def state(self) -> str:
-        return self._head_client().call("get_actor_state", self.actor_id)
+        return self._head_call("get_actor_state", self.actor_id)
 
     def kill(self, no_restart: bool = True) -> None:
         """Deliberate kill — distinguished from a crash so the supervisor does not
         revive it (parity: ApplicationInfo.scala:119-130 kill/retry pathology)."""
-        self._head_client().call("kill_actor", self.actor_id, no_restart)
+        self._head_call("kill_actor", self.actor_id, no_restart)
 
     def wait_ready(self, timeout: float = 120.0) -> "ActorHandle":
-        self._head_client().call("wait_actor_ready", self.actor_id, timeout,
-                                 timeout=timeout + 10.0)
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        try:
+            self._head_client().call("wait_actor_ready", self.actor_id,
+                                     timeout, timeout=timeout + 10.0)
+        except ConnectionLost:
+            # transient reset: retry with only the REMAINING budget so the
+            # caller's timeout contract holds
+            with self._lock:
+                if self._head is not None:
+                    self._head.close()
+                    self._head = None
+            remaining = max(1.0, deadline - _time.monotonic())
+            self._head_client().call("wait_actor_ready", self.actor_id,
+                                     remaining, timeout=remaining + 10.0)
         return self
 
 

@@ -141,6 +141,12 @@ class HeadService:
     def store_arena_reap(self, *a):
         return self._rt.store_server.arena_reap(*a)
 
+    def store_fetch_payload(self, *a):
+        return self._rt.store_server.fetch_payload(*a)
+
+    def store_store_payload(self, *a):
+        return self._rt.store_server.store_payload(*a)
+
     # ---- actor lifecycle ----------------------------------------------------
     def fetch_actor_spec(self, actor_id: str) -> Dict[str, Any]:
         rec = self._rt.record(actor_id)
@@ -421,6 +427,11 @@ class RuntimeContext:
             overrides[ENV_ACTOR_ID] = rec.spec.actor_id
             overrides[ENV_SESSION] = self.session_id
             overrides[ENV_SESSION_DIR] = self.session_dir
+            node = self.resource_manager.get_node(rec.node_id)
+            if node is not None and self.node_is_remote(node):
+                # a different machine cannot map this host's shared memory:
+                # its store client does payload IO over the table-server RPC
+                overrides["RDT_STORE_REMOTE"] = "1"
             # forward the driver's import path: cloudpickle pickles classes
             # by reference, so the child must resolve the driver's modules
             # (the agent appends its own path after these)
@@ -578,6 +589,12 @@ class RuntimeContext:
         return node_id, (dict(spec.resources) if node_id is not None else {})
 
     # ---- nodes --------------------------------------------------------------
+    def node_is_remote(self, node) -> bool:
+        """True when processes on ``node`` cannot map this host's shared
+        memory (the node is another machine) — the single source of the
+        data-plane locality rule for both actor and SPMD-rank spawns."""
+        return node.address not in ("127.0.0.1", self.server.address[0])
+
     def register_node_agent(self, host: str, port: int,
                             resources: Dict[str, float],
                             address: str) -> Dict[str, Any]:

@@ -57,8 +57,9 @@ class NodeAgentService:
     def __init__(self, agent: "NodeAgent"):
         self._agent = agent
 
-    def spawn(self, env_overrides: Dict[str, str], log_name: str) -> int:
-        return self._agent.spawn(env_overrides, log_name)
+    def spawn(self, env_overrides: Dict[str, str], log_name: str,
+              argv: Optional[list] = None) -> int:
+        return self._agent.spawn(env_overrides, log_name, argv)
 
     def poll(self, pid: int) -> Optional[int]:
         return self._agent.poll(pid)
@@ -103,7 +104,11 @@ class NodeAgent:
                     self.node_id, head_url, resources)
 
     # ---- process management (driven by the head) ----------------------------
-    def spawn(self, env_overrides: Dict[str, str], log_name: str) -> int:
+    def spawn(self, env_overrides: Dict[str, str], log_name: str,
+              argv: Optional[list] = None) -> int:
+        """Spawn a runtime process here; ``argv`` defaults to the actor
+        bootstrap but callers may launch other entry points (e.g. SPMD gang
+        ranks, ``raydp_tpu.spmd.worker``)."""
         env = dict(os.environ)
         env.update(env_overrides)
         # the child resolves driver-pickled classes by reference: the head's
@@ -115,9 +120,10 @@ class NodeAgent:
         env["PYTHONPATH"] = os.pathsep.join(paths)
         log_path = os.path.join(self.log_dir, f"{log_name}.out")
         out = open(log_path, "ab")
+        cmd = [sys.executable] + (list(argv) if argv
+                                  else ["-m", "raydp_tpu.runtime.actor_main"])
         proc = subprocess.Popen(
-            [sys.executable, "-m", "raydp_tpu.runtime.actor_main"],
-            env=env, stdout=out, stderr=subprocess.STDOUT,
+            cmd, env=env, stdout=out, stderr=subprocess.STDOUT,
             start_new_session=True, preexec_fn=_die_with_parent)
         out.close()
         with self._lock:

@@ -131,6 +131,63 @@ class ObjectStoreServer:
             self._table[object_id] = _Entry(segment, size, kind, owner, offset)
         self._reap_deferred()
 
+    # -- remote payload path (readers/writers on OTHER machines) --------------
+    def fetch_payload(self, object_id: str) -> Tuple[bytes, str]:
+        """Payload bytes + kind, for clients that cannot map this host's
+        shared memory (actors on node-agent machines). The zero-copy fast
+        path stays same-host; cross-host transfers ride the control RPC —
+        the role Ray's object transfer service plays for the reference."""
+        segment, size, kind, offset = self.lookup(object_id)
+        if offset >= 0:
+            with self._arena_lock:
+                if self._arena is None:
+                    raise KeyError(f"arena gone; object {object_id} unreadable")
+                return bytes(self._arena.view(offset, size)), kind
+        shm = shared_memory.SharedMemory(name=segment)
+        try:
+            _untrack(shm)
+            return bytes(shm.buf[:size]), kind
+        finally:
+            shm.close()
+
+    def store_payload(self, object_id: str, data: bytes, kind: str,
+                      owner: str) -> int:
+        """Write + seal on behalf of a remote client; returns the size."""
+        size = len(data)
+        offset = None
+        segment = None
+        with self._arena_lock:
+            if self._arena is not None:
+                segment = self._arena.segment
+                offset = self._arena.alloc(size)
+                if offset is not None:
+                    try:
+                        if size:
+                            self._arena.view(offset, size)[:] = data
+                    except BaseException:
+                        self._arena.free(offset)
+                        raise
+        if offset is not None:
+            try:
+                self.seal(object_id, segment, size, kind, owner, offset)
+            except BaseException:
+                with self._arena_lock:
+                    if self._arena is not None:
+                        self._arena.free(offset)
+                raise
+            return size
+        seg = f"rdt{self.session_id[:8]}_{object_id}"
+        shm = shared_memory.SharedMemory(name=seg, create=True,
+                                         size=max(size, 1))
+        try:
+            if size:
+                shm.buf[:size] = data
+        finally:
+            _untrack(shm)
+            shm.close()
+        self.seal(object_id, seg, size, kind, owner)
+        return size
+
     # -- read path ------------------------------------------------------------
     def lookup(self, object_id: str) -> Tuple[str, int, str, int]:
         with self._lock:
@@ -273,7 +330,8 @@ class ObjectStoreClient:
     process it is the server itself; in actor processes it is an RPC proxy.
     """
 
-    def __init__(self, server, session_id: str, default_owner: str = DRIVER_OWNER):
+    def __init__(self, server, session_id: str, default_owner: str = DRIVER_OWNER,
+                 remote: Optional[bool] = None):
         self._server = server
         self.session_id = session_id
         self.default_owner = default_owner
@@ -281,6 +339,11 @@ class ObjectStoreClient:
         self._lock = threading.Lock()
         self._arena = None          # native write handle, lazily probed
         self._arena_probed = False
+        # remote mode: this process cannot map the head's shared memory (it
+        # runs on another machine, spawned by a node agent there); all
+        # payload IO goes through the table server's fetch/store RPCs
+        self.remote = (os.environ.get("RDT_STORE_REMOTE") == "1"
+                       if remote is None else bool(remote))
 
     # -- segment naming: session-scoped so shutdown can sweep leftovers -------
     def _segment_name(self, object_id: str) -> str:
@@ -309,6 +372,12 @@ class ObjectStoreClient:
     def put_raw(self, data, kind: str = KIND_RAW, owner: Optional[str] = None) -> ObjectRef:
         object_id = new_object_id()
         size = len(data)
+        if self.remote:
+            payload = bytes(data.cast("B")) if isinstance(data, memoryview) \
+                else bytes(data)
+            self._server.store_payload(object_id, payload, kind,
+                                       owner or self.default_owner)
+            return ObjectRef(id=object_id, size=size, kind=kind)
         arena = self._write_arena()
         if arena is not None:
             offset = arena.alloc(size)
@@ -368,6 +437,9 @@ class ObjectStoreClient:
 
     # -- read -----------------------------------------------------------------
     def _attach(self, object_id: str) -> Tuple[memoryview, str]:
+        if self.remote:
+            data, kind = self._server.fetch_payload(object_id)
+            return memoryview(data), kind
         segment, size, kind, offset = self._server.lookup(object_id)
         with self._lock:
             shm = self._attached.get(segment)

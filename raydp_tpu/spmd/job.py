@@ -222,26 +222,60 @@ class SPMDJob:
         os.makedirs(base, exist_ok=True)
         return os.path.join(base, f"spmd-{self.job_name}-rank{rank}.out")
 
-    def _spawn_rank(self, rank: int) -> subprocess.Popen:
-        env = dict(os.environ)
-        env.update(self.extra_env)
+    def _rank_agent(self, rank: int):
+        """(agent client, node) serving this rank's placement bundle, when the
+        bundle landed on a node-agent machine — gang ranks then spawn there,
+        one process per host, the way `mpirun -hosts` fans ranks out
+        (mpi_job.py:240-278)."""
         from raydp_tpu.runtime import head as head_mod
+
+        if self._placement_group_id is None or not head_mod.runtime_initialized():
+            return None, None
+        rt = head_mod.get_runtime()
+        group = rt.resource_manager.get_group(self._placement_group_id)
+        if group is None or rank >= len(group.bundles):
+            return None, None
+        node_id = group.bundle_node(rank)
+        agent = rt.node_agents.get(node_id) if node_id else None
+        node = rt.resource_manager.get_node(node_id) if node_id else None
+        return agent, node
+
+    def _spawn_rank(self, rank: int):
+        env_overrides: Dict[str, str] = dict(self.extra_env)
+        from raydp_tpu.runtime import head as head_mod
+        rt = None
         if head_mod.runtime_initialized():
             # hand ranks the session so they join the data plane
             # (parity: ray.init in every MPI rank, mpi_worker.py:159-160)
             rt = head_mod.get_runtime()
-            env[head_mod.ENV_HEAD] = rt.server.url
-            env[head_mod.ENV_SESSION] = rt.session_id
-            env[head_mod.ENV_SESSION_DIR] = rt.session_dir
-        env[ENV_JOB_ID] = self.job_name
-        env[ENV_DRIVER] = self._server.url
-        env[ENV_RANK] = str(rank)
-        env[ENV_WORLD] = str(self.world_size)
-        env[ENV_JAX_DIST] = "1" if self.jax_distributed else "0"
+            env_overrides[head_mod.ENV_HEAD] = rt.server.url
+            env_overrides[head_mod.ENV_SESSION] = rt.session_id
+            env_overrides[head_mod.ENV_SESSION_DIR] = rt.session_dir
+        env_overrides[ENV_JOB_ID] = self.job_name
+        env_overrides[ENV_DRIVER] = self._server.url
+        env_overrides[ENV_RANK] = str(rank)
+        env_overrides[ENV_WORLD] = str(self.world_size)
+        env_overrides[ENV_JAX_DIST] = "1" if self.jax_distributed else "0"
         driver_path = [p for p in sys.path if p]
-        if env.get("PYTHONPATH"):
-            driver_path.append(env["PYTHONPATH"])
-        env["PYTHONPATH"] = os.pathsep.join(driver_path)
+        if env_overrides.get("PYTHONPATH"):  # user extra_env path first
+            driver_path.insert(0, env_overrides["PYTHONPATH"])
+        if os.environ.get("PYTHONPATH"):
+            driver_path.append(os.environ["PYTHONPATH"])
+        env_overrides["PYTHONPATH"] = os.pathsep.join(driver_path)
+
+        agent, node = self._rank_agent(rank)
+        if agent is not None:
+            if rt is not None and node is not None and rt.node_is_remote(node):
+                env_overrides["RDT_STORE_REMOTE"] = "1"
+            pid = agent.call("spawn", env_overrides,
+                             f"spmd-{self.job_name}-rank{rank}",
+                             ["-u", "-m", "raydp_tpu.spmd.worker"],
+                             timeout=30.0)
+            from raydp_tpu.runtime.head import _RemoteProcess
+            return _RemoteProcess(agent, pid, node.node_id if node else "")
+
+        env = dict(os.environ)
+        env.update(env_overrides)
         out = open(self._log_path(rank), "ab")
         proc = subprocess.Popen(
             [sys.executable, "-u", "-m", "raydp_tpu.spmd.worker"],
@@ -289,10 +323,14 @@ class SPMDJob:
             except Exception:
                 pass
         deadline = time.time() + 5.0
+        from raydp_tpu.runtime.head import _RemoteProcess
         for p in self._procs:
             while p.poll() is None and time.time() < deadline:
                 time.sleep(0.05)
             if p.poll() is None:
+                if isinstance(p, _RemoteProcess):
+                    p.kill()
+                    continue
                 try:
                     os.killpg(p.pid, signal.SIGKILL)
                 except (ProcessLookupError, PermissionError):

@@ -252,6 +252,11 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                             loss=self._loss, metrics=list(self._metrics))
 
             n_rows = int(np.asarray(y).shape[0])
+            vs = float(self.fit_kwargs.get("validation_split", 0.0) or 0.0)
+            if 0.0 < vs < 1.0:
+                # keras holds the tail split out of training; throughput must
+                # count only trained rows
+                n_rows = int(n_rows * (1.0 - vs))
             history = []
             for i in range(len(hist.epoch)):
                 row = {"epoch": i,

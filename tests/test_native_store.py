@@ -194,3 +194,30 @@ def test_store_native_off(monkeypatch):
         assert offset == -1
     finally:
         rt.shutdown()
+
+
+def test_remote_store_client_roundtrip(runtime):
+    """A store client in remote mode (a process on a node-agent machine that
+    cannot map the head's shared memory) reads and writes payloads through
+    the table server's fetch/store RPCs — the cross-host data plane."""
+    from raydp_tpu.runtime.object_store import ObjectStoreClient
+
+    local = runtime.store_client
+    remote = ObjectStoreClient(runtime.store_server, runtime.session_id,
+                               default_owner="remote-node", remote=True)
+
+    # local write (arena fast path) → remote read via RPC bytes
+    table = pa.table({"a": np.arange(500), "b": np.random.rand(500)})
+    ref = local.put(table)
+    got = remote.get(ref)
+    assert got.equals(table)
+
+    # remote write (server-mediated) → local zero-copy read
+    ref2 = remote.put({"x": [1, 2, 3]})
+    assert local.get(ref2) == {"x": [1, 2, 3]}
+    t3 = pa.table({"c": np.arange(64, dtype=np.int64)})
+    ref3 = remote.put(t3)
+    assert local.get(ref3, zero_copy=True).equals(t3)
+    # the remote write is owned by the remote actor: owner sweep reclaims it
+    runtime.store_server.free_owned_by("remote-node")
+    assert not local.contains(ref2)

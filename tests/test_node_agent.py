@@ -112,3 +112,28 @@ def test_agent_death_reroutes_restartable_actor(runtime):
             os.killpg(a1.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
+
+
+def test_spmd_ranks_spawn_on_agent_nodes(runtime):
+    """A gang with SPREAD placement fans its ranks out across node agents —
+    one rank process per machine, mpirun-hosts style."""
+    from raydp_tpu.spmd import create_spmd_job
+
+    rt = runtime
+    a1 = _start_agent(rt.server.url)
+    try:
+        _wait_nodes(rt, 2)
+        job = create_spmd_job("agent-gang", world_size=2,
+                              placement_strategy="SPREAD")
+        job.start()
+        try:
+            ppids = job.run(lambda ctx: os.getppid())
+        finally:
+            job.stop()
+        assert a1.pid in ppids, (ppids, a1.pid)      # one rank on the agent
+        assert os.getpid() in ppids                  # one rank local
+    finally:
+        try:
+            os.killpg(a1.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
