@@ -275,6 +275,7 @@ def fit_gbdt(
     evals: Optional[Tuple[np.ndarray, np.ndarray]] = None,
     early_stopping_rounds: Optional[int] = None,
     bin_edges: Optional[np.ndarray] = None,
+    mesh=None,
 ) -> Tuple[GBDTModel, np.ndarray, Dict[str, List[float]]]:
     """Fit a forest; returns (model, final train margins, evals_result).
 
@@ -284,6 +285,12 @@ def fit_gbdt(
     ``early_stopping_rounds`` the loop stops once the eval metric has not
     improved for that many rounds and the forest is truncated to the best
     iteration (recorded on ``model.best_iteration``).
+
+    ``mesh`` shards the ROW dimension over the mesh's data axes: the
+    per-level histograms become partial scatter-adds on each device with XLA
+    inserting the cross-device reduction — the exact spot XGBoost's Rabit
+    allreduce sits in the reference's distributed trainer. Split finding and
+    tree tables stay replicated.
     """
     known = ("reg:squarederror", "binary:logistic", "multi:softmax",
              "multi:softprob")
@@ -315,7 +322,32 @@ def fit_gbdt(
     kwargs = dict(max_depth=max_depth, num_bins=num_bins,
                   learning_rate=learning_rate, reg_lambda=reg_lambda,
                   min_child_weight=min_child_weight, objective=objective)
-    Xb_j, y_j, w_j = jnp.asarray(Xb), jnp.asarray(y), jnp.asarray(w)
+    n_orig = len(y)
+    if mesh is not None:
+        from raydp_tpu.parallel import batch_sharding
+        from raydp_tpu.parallel.mesh import data_axes
+
+        rows = batch_sharding(mesh)
+        # static shapes: pad rows to the sharding divisor with zero-weight
+        # rows (they contribute nothing to any histogram or leaf)
+        total = int(np.prod([mesh.shape[a] for a in data_axes(mesh)]))
+        pad = (-len(y)) % total
+        if pad:
+            Xb = np.concatenate([Xb, np.zeros((pad, Xb.shape[1]), Xb.dtype)])
+            y = np.concatenate([y, np.zeros(pad, y.dtype)])
+            w = np.concatenate([w, np.zeros(pad, w.dtype)])
+            if multi:
+                pred = jnp.concatenate(
+                    [pred, jnp.broadcast_to(pred[0], (pad, pred.shape[1]))])
+            else:
+                pred = jnp.concatenate(
+                    [pred, jnp.full(pad, pred[0], pred.dtype)])
+        Xb_j = jax.device_put(jnp.asarray(Xb), rows)
+        y_j = jax.device_put(jnp.asarray(y), rows)
+        w_j = jax.device_put(jnp.asarray(w), rows)
+        pred = jax.device_put(pred, rows)
+    else:
+        Xb_j, y_j, w_j = jnp.asarray(Xb), jnp.asarray(y), jnp.asarray(w)
 
     evals_result: Dict[str, List[float]] = {}
     if evals is None:
@@ -368,4 +400,4 @@ def fit_gbdt(
                       base_score=np.asarray(base_score),
                       max_depth=max_depth, objective=objective,
                       best_iteration=best_iteration)
-    return model, np.asarray(pred), evals_result
+    return model, np.asarray(pred)[:n_orig], evals_result

@@ -47,6 +47,7 @@ class GBDTEstimator(EstimatorInterface, FrameEstimatorInterface):
         checkpoint_dir: Optional[str] = None,
         early_stopping_rounds: Optional[int] = None,
         weight_column: Optional[str] = None,
+        mesh=None,
     ):
         params = dict(params or {})
         self.objective = params.pop("objective", "reg:squarederror")
@@ -68,6 +69,7 @@ class GBDTEstimator(EstimatorInterface, FrameEstimatorInterface):
         self.checkpoint_dir = checkpoint_dir
         self.early_stopping_rounds = early_stopping_rounds
         self.weight_column = weight_column
+        self.mesh = mesh  # rows sharded over its data axes (distributed trees)
         self._model = None
         self._result: Optional[TrainingResult] = None
         self.evals_result: Dict = {}
@@ -117,7 +119,8 @@ class GBDTEstimator(EstimatorInterface, FrameEstimatorInterface):
             reg_lambda=self.reg_lambda, min_child_weight=self.min_child_weight,
             objective=self.objective, num_class=self.num_class,
             sample_weight=w, evals=evals,
-            early_stopping_rounds=self.early_stopping_rounds)
+            early_stopping_rounds=self.early_stopping_rounds,
+            mesh=self.mesh)
         self.evals_result = evals_result
 
         report = {"num_trees": model.num_trees}
