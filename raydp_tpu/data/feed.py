@@ -188,17 +188,47 @@ class HostBatchIterator:
         return batch, rest, buffered - self.batch_size
 
 
+def process_local_batch_rows(sharding, global_batch: int) -> Tuple[int, int]:
+    """The contiguous ``[start, stop)`` slice of a ``(global_batch,)`` array
+    that THIS process's devices address under ``sharding``.
+
+    This is what a gang rank must feed ``make_array_from_process_local_data``:
+    with the batch sharded over a >1 data axis spanning processes it is a
+    proper slice; with the batch replicated across processes (size-1 data axis
+    — pure fsdp/expert meshes) it is the full ``(0, global_batch)`` range on
+    every process.
+    """
+    idx_map = sharding.addressable_devices_indices_map((global_batch,))
+    intervals = set()
+    for idx in idx_map.values():
+        sl = idx[0] if idx else slice(None)
+        intervals.add((sl.start or 0,
+                       global_batch if sl.stop is None else sl.stop))
+    lo = min(s for s, _ in intervals)
+    hi = max(e for _, e in intervals)
+    cur = lo
+    for s, e in sorted(intervals):
+        if s > cur:
+            raise ValueError(
+                f"process-local batch rows are not contiguous under {sharding}"
+                f": gap at [{cur}, {s})")
+        cur = max(cur, e)
+    return int(lo), int(hi)
+
+
 class GangShardIterator:
     """Per-rank host batches that compose into globally-consistent batches.
 
     Global batch ``k`` covers dataset rows ``[k*B, (k+1)*B)`` in block order —
     exactly the batches a single-process :class:`HostBatchIterator` with
-    ``shuffle=False`` cuts — and rank ``r`` of ``w`` yields the
-    ``[r*B/w, (r+1)*B/w)`` slice of each. All ranks permute the *batch order*
-    with the same seed (no within-block shuffling), so every rank walks the
-    same global batch sequence and ``jax.make_array_from_process_local_data``
-    assembles the intended global array. This is the multi-host analogue of
-    the reference's per-worker dataset shard (torch/estimator.py:226-241 via
+    ``shuffle=False`` cuts — and rank ``r`` of ``w`` yields its addressable
+    slice of each: ``row_range`` (derived from the batch sharding via
+    :func:`process_local_batch_rows`) when given, else the equal split
+    ``[r*B/w, (r+1)*B/w)``. All ranks permute the *batch order* with the same
+    seed (no within-block shuffling), so every rank walks the same global
+    batch sequence and ``jax.make_array_from_process_local_data`` assembles
+    the intended global array. This is the multi-host analogue of the
+    reference's per-worker dataset shard (torch/estimator.py:226-241 via
     ``divide_blocks``), strengthened to give bit-identical global batches for
     any world size.
     """
@@ -212,13 +242,21 @@ class GangShardIterator:
         columns: Dict[str, Tuple[ColumnSpec, np.dtype]],
         shuffle: bool = False,
         seed: int = 0,
+        row_range: Optional[Tuple[int, int]] = None,
     ):
-        if global_batch % world_size != 0:
-            raise ValueError(
-                f"global batch {global_batch} not divisible by world size "
-                f"{world_size}")
         if not (0 <= rank < world_size):
             raise ValueError(f"rank {rank} out of range for world {world_size}")
+        if row_range is None:
+            if global_batch % world_size != 0:
+                raise ValueError(
+                    f"global batch {global_batch} not divisible by world size "
+                    f"{world_size}")
+            per = global_batch // world_size
+            row_range = (rank * per, (rank + 1) * per)
+        lo, hi = row_range
+        if not (0 <= lo < hi <= global_batch):
+            raise ValueError(f"row_range {row_range} out of range for "
+                             f"global batch {global_batch}")
         self.dataset = dataset
         self.global_batch = global_batch
         self.world_size = world_size
@@ -226,7 +264,8 @@ class GangShardIterator:
         self.columns = _normalize_columns(columns)
         self.shuffle = shuffle
         self.seed = seed
-        self.per_rank = global_batch // world_size
+        self.row_range = (int(lo), int(hi))
+        self.per_rank = int(hi) - int(lo)
         self._starts = np.cumsum([0] + list(dataset.block_sizes()))
         self.total = int(self._starts[-1])
 
@@ -252,7 +291,7 @@ class GangShardIterator:
             np.random.RandomState(self.seed).shuffle(order)
         tables: Dict[int, pa.Table] = {}  # zero-copy views, live for the epoch
         for k in order:
-            start = int(k) * self.global_batch + self.rank * self.per_rank
+            start = int(k) * self.global_batch + self.row_range[0]
             parts = []
             for b, off, length in self._runs(start, start + self.per_rank):
                 t = tables.get(b)
@@ -273,7 +312,7 @@ class DeviceFeed:
         batch_size: int,
         columns: Dict[str, Tuple[ColumnSpec, np.dtype]],
         mesh=None,
-        data_axis: str = "data",
+        data_axis: Optional[str] = None,
         shard: Optional[ShardSpec] = None,
         shuffle: bool = True,
         seed: int = 0,
@@ -291,8 +330,17 @@ class DeviceFeed:
         self.prefetch = max(1, prefetch)
         self._shardings = None
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-            self._sharding = NamedSharding(mesh, PartitionSpec(data_axis))
+            if data_axis is None:
+                # the batch's true sharding spans data AND fsdp axes; using
+                # only "data" on a pure-fsdp mesh would be a (size-1-axis)
+                # replicated sharding, and in gang mode each process would
+                # then assemble a DIFFERENT "replicated" array from its own
+                # rows — silently inconsistent global batches
+                from raydp_tpu.parallel.mesh import batch_sharding
+                self._sharding = batch_sharding(mesh)
+            else:
+                from jax.sharding import NamedSharding, PartitionSpec
+                self._sharding = NamedSharding(mesh, PartitionSpec(data_axis))
         else:
             self._sharding = None
 

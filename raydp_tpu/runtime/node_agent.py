@@ -108,9 +108,15 @@ class NodeAgent:
               argv: Optional[list] = None) -> int:
         """Spawn a runtime process here; ``argv`` defaults to the actor
         bootstrap but callers may launch other entry points (e.g. SPMD gang
-        ranks, ``raydp_tpu.spmd.worker``)."""
+        ranks, ``raydp_tpu.spmd.worker``). An override valued ``None`` removes
+        the variable from the child env (same contract as the local spawn
+        path, SPMDJob._spawn_rank)."""
         env = dict(os.environ)
-        env.update(env_overrides)
+        for k, v in env_overrides.items():
+            if v is None:
+                env.pop(k, None)
+            else:
+                env[k] = v
         # the child resolves driver-pickled classes by reference: the head's
         # forwarded PYTHONPATH (driver sys.path) takes precedence — matching
         # local-spawn semantics so one session never runs two code versions —

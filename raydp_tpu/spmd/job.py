@@ -241,6 +241,9 @@ class SPMDJob:
         return agent, node
 
     def _spawn_rank(self, rank: int):
+        # an override valued None means "remove from the child env" (e.g.
+        # dropping a TPU-plugin discovery var so CPU-pinned ranks cannot touch
+        # a tunnel) — honored by both the local spawn below and NodeAgent.spawn
         env_overrides: Dict[str, str] = dict(self.extra_env)
         from raydp_tpu.runtime import head as head_mod
         rt = None
@@ -265,6 +268,8 @@ class SPMDJob:
 
         agent, node = self._rank_agent(rank)
         if agent is not None:
+            # None-valued overrides ride through: the agent applies them as
+            # removals in the child env (NodeAgent.spawn)
             if rt is not None and node is not None and rt.node_is_remote(node):
                 env_overrides["RDT_STORE_REMOTE"] = "1"
             pid = agent.call("spawn", env_overrides,
@@ -275,7 +280,11 @@ class SPMDJob:
             return _RemoteProcess(agent, pid, node.node_id if node else "")
 
         env = dict(os.environ)
-        env.update(env_overrides)
+        for k, v in env_overrides.items():
+            if v is None:
+                env.pop(k, None)
+            else:
+                env[k] = v
         out = open(self._log_path(rank), "ab")
         proc = subprocess.Popen(
             [sys.executable, "-u", "-m", "raydp_tpu.spmd.worker"],

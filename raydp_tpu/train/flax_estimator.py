@@ -201,26 +201,10 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
     @staticmethod
     def _place_state(tree, shardings):
-        """Place a (host or local-device) pytree under global shardings.
-
-        Single-process: plain sharded ``device_put``. Multi-process (gang
-        mode): ``make_array_from_callback`` — every process holds the full
-        host value (same rng / same checkpoint), each device reads its shard.
-        """
-        import jax
-
-        if jax.process_count() > 1:
-            def _put(x, s):
-                if x is None:
-                    return None
-                host = np.asarray(x)
-                return jax.make_array_from_callback(
-                    host.shape, s, lambda idx: host[idx])
-        else:
-            def _put(x, s):
-                return None if x is None else jax.device_put(x, s)
-        return jax.tree.map(_put, tree, shardings,
-                            is_leaf=lambda x: x is None)
+        """Place a host pytree under global shardings (see
+        :func:`raydp_tpu.train.checkpoint.place_tree`)."""
+        from raydp_tpu.train import checkpoint as ckpt
+        return ckpt.place_tree(tree, shardings)
 
     def _train_loop(self, mesh, feed, eval_feed, ckpt_dir: str,
                     max_retries: int = 0, resume: bool = False):
@@ -336,10 +320,9 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         epoch = 0
         retries = 0
         if resume:
-            restored = ckpt.restore(ckpt_dir, state)
+            restored = ckpt.restore_placed(ckpt_dir, state, state_sharding)
             if restored is not None:
-                host_state, done_epoch = restored
-                state = self._place_state(host_state, state_sharding)
+                state, done_epoch = restored
                 epoch = done_epoch + 1
                 extra = ckpt.restore_extra(ckpt_dir)
                 if extra and "history" in extra:
@@ -402,10 +385,9 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                     raise
                 logger.warning("epoch %d failed (%s); restoring from checkpoint "
                                "(retry %d/%d)", epoch, e, retries, max_retries)
-                restored = ckpt.restore(ckpt_dir, state)
+                restored = ckpt.restore_placed(ckpt_dir, state, state_sharding)
                 if restored is not None:
-                    host_state, done_epoch = restored
-                    state = self._place_state(host_state, state_sharding)
+                    state, done_epoch = restored
                     epoch = done_epoch + 1
                     extra = ckpt.restore_extra(ckpt_dir)
                     if extra and "history" in extra:
@@ -417,7 +399,9 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
     def fit_gang(self, train_ds, evaluate_ds=None, *, num_workers: int = 2,
                  max_retries: int = 0, job_name: Optional[str] = None,
                  run_timeout: float = 3600.0,
-                 start_timeout: float = 180.0) -> TrainingResult:
+                 start_timeout: float = 180.0,
+                 worker_env: Optional[Dict[str, str]] = None
+                 ) -> TrainingResult:
         """Train as a gang of ``num_workers`` processes under one global
         ``jax.distributed`` mesh.
 
@@ -427,11 +411,19 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         object store, feeds its slice of every global batch
         (:class:`GangShardIterator` → ``make_array_from_process_local_data``),
         and runs the same jitted train loop; XLA inserts the gradient
-        collectives over the global mesh. Rank 0 writes orbax checkpoints.
+        collectives over the global mesh. Parameters may be sharded ACROSS
+        processes (fsdp/expert/tensor axes spanning hosts): checkpoints use
+        the sharded multi-writer format (each process saves the shards it
+        owns, see train/checkpoint.py) and the returned model is assembled
+        with a ``process_allgather``.
         A dead or failing rank fails the whole gang (XLA collectives are not
         elastic mid-program, SURVEY.md §7 hard part (c)); the driver then
         restarts the gang, which resumes from the last checkpoint — up to
         ``max_retries`` restarts.
+
+        ``worker_env`` adds/overrides rank-process environment (a ``None``
+        value removes the variable) — e.g. pinning ranks to CPU devices on a
+        machine whose one TPU chip the driver owns.
         """
         import copy
         import uuid as _uuid
@@ -441,16 +433,6 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         if self._mesh is not None:
             raise ValueError("fit_gang builds its mesh inside the ranks; "
                              "pass mesh_spec instead of a driver-built mesh")
-        if self.param_rules is not None or (
-                self._mesh_spec is not None and any(
-                    getattr(self._mesh_spec, a) != 1
-                    for a in ("fsdp", "expert", "seq", "tensor"))):
-            # chief-only orbax save + device_get(state) require every process
-            # to hold full replicas; cross-process param sharding needs a
-            # multihost checkpoint path (not wired up yet) — fail clearly
-            raise NotImplementedError(
-                "fit_gang currently supports replicated parameters (pure DP); "
-                "drop param_rules / non-data mesh axes")
         ckpt_dir = self.checkpoint_dir or tempfile.mkdtemp(prefix="rdt-gang-")
         train_payload = train_ds.portable()
         eval_payload = evaluate_ds.portable() if evaluate_ds is not None else None
@@ -465,7 +447,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
         job = create_spmd_job(job_name or f"flaxfit-{_uuid.uuid4().hex[:6]}",
                               num_workers, jax_distributed=True,
-                              timeout=start_timeout)
+                              env=worker_env, timeout=start_timeout)
         attempts = 0
         while True:
             try:
@@ -504,12 +486,21 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
         columns = self._columns()
         mesh = self._build_mesh()  # jax.devices() is global under the gang
+        from raydp_tpu.data.feed import process_local_batch_rows
+        from raydp_tpu.parallel import batch_sharding
+
+        # this process's addressable slice of each global batch, derived from
+        # the actual batch sharding: with the batch replicated over a size-1
+        # data axis (e.g. pure fsdp/expert meshes) EVERY process feeds the
+        # full batch; with a >1 data axis each feeds its contiguous rows
+        row_range = process_local_batch_rows(batch_sharding(mesh),
+                                             self.batch_size)
         train_ds = DistributedDataset.from_portable(train_payload)
         feed = DeviceFeed(
             train_ds, self.batch_size, columns, mesh=mesh,
             host_iter=GangShardIterator(
                 train_ds, self.batch_size, ctx.world_size, ctx.rank, columns,
-                shuffle=self.shuffle, seed=self.seed))
+                shuffle=self.shuffle, seed=self.seed, row_range=row_range))
         eval_feed = None
         if eval_payload is not None:
             eval_ds = DistributedDataset.from_portable(eval_payload)
@@ -517,17 +508,26 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 eval_ds, self.batch_size, columns, mesh=mesh,
                 host_iter=GangShardIterator(
                     eval_ds, self.batch_size, ctx.world_size, ctx.rank,
-                    columns, shuffle=False, seed=self.seed))
+                    columns, shuffle=False, seed=self.seed,
+                    row_range=row_range))
 
         state, history = self._train_loop(mesh, feed, eval_feed, ckpt_dir,
                                           max_retries=0, resume=True)
         out = {"history": history}
+        # collect the trained variables on every host (collective — all ranks
+        # participate), then rank 0 returns them; with params sharded across
+        # processes this is the only way any single process sees full values
+        from jax.experimental import multihost_utils
+
+        model_vars = {"params": state.params}
+        bstats = getattr(state, "batch_stats", None)
+        if bstats is not None:
+            model_vars["batch_stats"] = bstats
+        host_vars = jax.tree.map(
+            np.asarray, multihost_utils.process_allgather(model_vars,
+                                                          tiled=True))
         if ctx.rank == 0:
-            model_vars = {"params": jax.device_get(state.params)}
-            bstats = getattr(state, "batch_stats", None)
-            if bstats is not None:
-                model_vars["batch_stats"] = jax.device_get(bstats)
-            out["model_vars"] = model_vars
+            out["model_vars"] = host_vars
         return out
 
     # ----------------------------------------------------------- fit_on_frame
