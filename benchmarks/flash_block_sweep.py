@@ -1,10 +1,15 @@
 """Flash-attention block-size sweep on the real chip (VERDICT #5).
 
-Times the Pallas forward+backward through ``flash_attention`` for a grid of
-(block_q, block_k) at long context, printing μs/call and the best pair — the
-evidence behind the DEFAULT_BLOCK_* choices.
+Times forward and forward+backward through ``flash_attention`` for a grid of
+(block_q, block_k) at long context — the evidence behind the default block
+choices. Methodology for a remote-tunnel TPU backend: per-call timing is
+useless (~64 ms dispatch+fetch RTT, and ``block_until_ready`` does not truly
+sync), so every measurement chains ``--iters`` kernel applications on device
+inside ONE executable (``lax.scan`` feeding the output back as q) and fetches
+a scalar once; per-iter time = (wall - one RTT) / iters, with the RTT itself
+measured on a trivial op.
 
-Run: python benchmarks/flash_block_sweep.py [--seq-len 8192] [--dim 128]
+Run: python benchmarks/flash_block_sweep.py [--seq-len 8192] [--dim 64]
 """
 
 from __future__ import annotations
@@ -23,46 +28,89 @@ def main():
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--dim", type=int, default=64, help="head dim")
     ap.add_argument("--batch", type=int, default=1)
-    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--iters", type=int, default=16)
+    ap.add_argument("--grad", action="store_true",
+                    help="time fwd+bwd instead of fwd")
     args = ap.parse_args()
 
     import jax
     import jax.numpy as jnp
     import numpy as np
+    from jax import lax
 
     from raydp_tpu.ops.flash_attention import flash_attention
 
     B, T, H, D = args.batch, args.seq_len, args.heads, args.dim
+    iters = args.iters
     rng = np.random.RandomState(0)
-    q = jnp.asarray(rng.randn(B, T, H, D), jnp.bfloat16)
-    k = jnp.asarray(rng.randn(B, T, H, D), jnp.bfloat16)
-    v = jnp.asarray(rng.randn(B, T, H, D), jnp.bfloat16)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.randn(B, T, H, D).astype(np.float32) * 0.3).astype(jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+
+    def rtt_ms() -> float:
+        x = jnp.ones((8, 8))
+        f = jax.jit(lambda a, c: (a * c).sum())
+        float(f(x, 1.0))
+        t0 = time.perf_counter()
+        float(f(x, 2.0))
+        return (time.perf_counter() - t0) * 1e3
+
+    rtt = min(rtt_ms() for _ in range(3))
+    print(f"dispatch+fetch RTT: {rtt:.1f} ms (subtracted)", file=sys.stderr)
+
+    def timed(bq: int, bk: int) -> float:
+        if args.grad:
+            def one(x):
+                g = jax.grad(lambda qq: flash_attention(
+                    qq, k, v, causal=True, block_q=bq, block_k=bk)
+                    .astype(jnp.float32).sum())(x)
+                return g.astype(x.dtype)
+        else:
+            def one(x):
+                return flash_attention(x, k, v, causal=True,
+                                       block_q=bq, block_k=bk)
+
+        @jax.jit
+        def chained(x):
+            out = lax.scan(lambda c, _: (one(c), ()), x, None,
+                           length=iters)[0]
+            return out.astype(jnp.float32).sum()
+
+        float(chained(q))                    # compile + warm
+        t0 = time.perf_counter()
+        float(chained(q))
+        wall = (time.perf_counter() - t0) * 1e3
+        per_iter = (wall - rtt) / iters
+        if per_iter <= 0:
+            raise RuntimeError(
+                f"measurement below timing noise (wall {wall:.1f} ms <= RTT "
+                f"{rtt:.1f} ms) — raise --iters or --seq-len")
+        return per_iter
 
     results = []
     grid = [(128, 128), (128, 256), (256, 256), (256, 512), (512, 512),
             (512, 1024), (1024, 1024)]
+    what = "fwd+bwd" if args.grad else "fwd"
     for bq, bk in grid:
-            if bq > T or bk > T:
-                continue
-
-            def loss(q, bq=bq, bk=bk):
-                return flash_attention(q, k, v, causal=True,
-                                       block_q=bq, block_k=bk).sum()
-
-            step = jax.jit(jax.grad(loss))
-            g = step(q)
-            jax.block_until_ready(g)
-            t0 = time.perf_counter()
-            for _ in range(args.iters):
-                g = step(q)
-            jax.block_until_ready(g)
-            us = (time.perf_counter() - t0) / args.iters * 1e6
-            results.append((us, bq, bk))
-            print(f"blk_q={bq:5d} blk_k={bk:5d}  {us:9.1f} us/fwd+bwd",
-                  file=sys.stderr)
+        if bq > T or bk > T:
+            continue
+        try:
+            us = timed(bq, bk) * 1e3
+        except Exception as e:  # noqa: BLE001 - tunnel compiles can flake
+            print(f"blk_q={bq:5d} blk_k={bk:5d}  FAILED "
+                  f"({type(e).__name__}: {str(e)[:120]})", file=sys.stderr)
+            continue
+        results.append((us, bq, bk))
+        print(f"blk_q={bq:5d} blk_k={bk:5d}  {us:9.1f} us/{what}",
+              file=sys.stderr)
+    if not results:
+        raise SystemExit("every configuration failed")
     best = min(results)
-    print(f"best: blk_q={best[1]} blk_k={best[2]} ({best[0]:.1f} us) "
-          f"at B={B} T={T} H={H} D={D} on "
+    # causal flash fwd FLOPs: 2 matmuls x B*H*(T^2/2)*D x 2
+    flops = 4.0 * B * H * (T * T / 2) * D * (3.5 if args.grad else 1.0)
+    tflops = flops / (best[0] * 1e-6) / 1e12
+    print(f"best: blk_q={best[1]} blk_k={best[2]} ({best[0]:.1f} us/{what}, "
+          f"~{tflops:.1f} TFLOP/s) at B={B} T={T} H={H} D={D} on "
           f"{jax.devices()[0].device_kind}")
 
 

@@ -27,8 +27,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# swept on TPU v5e at T=8192, H=8, D=64 (benchmarks/flash_block_sweep.py,
+# 2026-07-30): fwd 9.1ms @128x128 -> 1.23ms @1024x1024 (55.9 TFLOP/s);
+# fwd+bwd flat within 3% across 256..1024, so the fwd winner decides.
+# 2048-wide blocks gain nothing (and 2048x2048 fails VMEM).
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
 
 
@@ -49,32 +53,46 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0].astype(jnp.float32)            # [blk_q, D]
-    k = k_ref[0].astype(jnp.float32)            # [blk_k, D]
-    v = v_ref[0].astype(jnp.float32)            # [blk_k, D]
+    def _body():
+        q = q_ref[0]                            # [blk_q, D], native dtype
+        k = k_ref[0]                            # [blk_k, D]
+        v = v_ref[0]                            # [blk_k, D]
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale     # [blk_q, blk_k]
+        # native-dtype MXU matmul (bf16 x bf16 -> f32); upcasting inputs to
+        # f32 first would cost ~4x MXU throughput for no accuracy gain over
+        # the f32 accumulator
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # [blk_q, blk_k]
+
+        if causal:
+            q_pos = qi * blk_q + lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            k_pos = ki * blk_k + lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+        m_prev = m_scr[:, 0]                                # [blk_q]
+        l_prev = l_scr[:, 0]
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new[:, None])
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=-1)
+        acc_scr[:] = (acc_scr[:] * correction[:, None]
+                      + jax.lax.dot_general(
+                          p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32))
+        m_scr[:, 0] = m_new
+        l_scr[:, 0] = l_new
 
     if causal:
-        q_pos = qi * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-        k_pos = ki * blk_k + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-
-    m_prev = m_scr[:, 0]                                # [blk_q]
-    l_prev = l_scr[:, 0]
-    m_blk = jnp.max(s, axis=-1)
-    m_new = jnp.maximum(m_prev, m_blk)
-    p = jnp.exp(s - m_new[:, None])
-    correction = jnp.exp(m_prev - m_new)
-    l_new = l_prev * correction + jnp.sum(p, axis=-1)
-    acc_scr[:] = (acc_scr[:] * correction[:, None]
-                  + jax.lax.dot_general(
-                      p, v, (((1,), (0,)), ((), ())),
-                      preferred_element_type=jnp.float32))
-    m_scr[:, 0] = m_new
-    l_scr[:, 0] = l_new
+        # causal block skipping: a k block strictly above the triangle (its
+        # first key after this q block's last query) contributes exactly
+        # zero — skip both matmuls, halving causal FLOPs
+        pl.when(qi * blk_q + (blk_q - 1) >= ki * blk_k)(_body)
+    else:
+        _body()
 
     @pl.when(ki == num_k - 1)
     def _finalize():
@@ -115,6 +133,9 @@ def _fwd_pallas(q3, k3, v3, *, scale: float, causal: bool, blk_q: int,
             pltpu.VMEM((blk_q, 128), jnp.float32),   # l
             pltpu.VMEM((blk_q, d), jnp.float32),     # acc
         ],
+        # bh and q blocks are independent; only the k walk carries state
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q3, k3, v3)
     return out, lse.reshape(bh, t)
