@@ -1,0 +1,94 @@
+"""Gang-SPMD job example — the MPI-pillar analogue (reference doc/mpi.md,
+mpi/mpi_job.py): gang-start N rank processes under one global ``jax.distributed``
+mesh, broadcast functions, gather world-size results, and read ETL output from
+the object store inside the ranks.
+
+    python examples/spmd_job.py [--world-size 2]
+
+Runs on CPU devices by default so it works anywhere; on a TPU pod the same
+code runs one rank per host and the collectives ride ICI.
+"""
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+sys.path.insert(0, os.path.dirname(_HERE))
+
+
+def global_mean_step(ctx):
+    """Each rank contributes its devices; XLA inserts the cross-rank reduce."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devices = jax.devices()              # GLOBAL devices across the gang
+    mesh = Mesh(devices, ("i",))
+    x = jnp.arange(len(devices), dtype=jnp.float32) + 1.0
+    mean = jax.jit(lambda v: v.mean(),
+                   in_shardings=NamedSharding(mesh, PartitionSpec("i")),
+                   out_shardings=NamedSharding(mesh, PartitionSpec()))(x)
+    return {"rank": ctx.rank, "n_global_devices": len(devices),
+            "global_mean": float(mean)}
+
+
+def count_rows(payload):
+    """A closure over a portable dataset handle: every rank re-opens the
+    dataset from the object store (parity: each MPI rank joins Ray and reads
+    the data plane, mpi_worker.py:159-160)."""
+
+    def _fn(ctx):
+        from raydp_tpu.data.dataset import DistributedDataset
+
+        ds = DistributedDataset.from_portable(payload)
+        # each rank counts a round-robin share of the blocks
+        mine = [i for i in range(ds.num_blocks())
+                if i % ctx.world_size == ctx.rank]
+        return sum(ds.get_block(i).num_rows for i in mine)
+
+    return _fn
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--world-size", type=int, default=2)
+    args = ap.parse_args()
+
+    import raydp_tpu
+    from raydp_tpu.data import from_frame
+    from raydp_tpu.spmd import create_spmd_job
+    from generate_nyctaxi import generate
+
+    session = raydp_tpu.init("spmd-example", num_executors=2,
+                             executor_cores=1, executor_memory="512MB")
+    try:
+        import tempfile
+        csv = os.path.join(tempfile.mkdtemp(prefix="rdt-spmd-"), "taxi.csv")
+        generate(20_000).to_csv(csv, index=False)
+        df = session.read.csv(csv, num_partitions=4)
+        ds = from_frame(df)
+        payload = ds.portable()
+
+        job = create_spmd_job("example", args.world_size,
+                              jax_distributed=True)
+        job.start()
+        try:
+            results = job.run(global_mean_step, timeout=300)
+            for r in results:
+                print(f"rank {r['rank']}: {r['n_global_devices']} global "
+                      f"devices, mean={r['global_mean']}")
+
+            counts = job.run(count_rows(payload), timeout=300)
+            print(f"rows counted across the gang: {sum(counts)} "
+                  f"(per-rank {counts})")
+            assert sum(counts) == df.count()
+        finally:
+            job.stop()
+    finally:
+        raydp_tpu.stop()
+
+
+if __name__ == "__main__":
+    main()

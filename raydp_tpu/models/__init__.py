@@ -11,8 +11,10 @@
 from raydp_tpu.models.mlp import MLP, NYCTaxiModel
 from raydp_tpu.models.dlrm import DLRM, criteo_batch_preprocessor, dlrm_param_rules
 from raydp_tpu.models.gbdt import GBDTModel, fit_gbdt
-from raydp_tpu.models.transformer import TransformerLM, lm_loss
+from raydp_tpu.models.transformer import (
+    TransformerLM, lm_loss, transformer_param_rules,
+)
 
 __all__ = ["MLP", "NYCTaxiModel", "DLRM", "criteo_batch_preprocessor",
            "dlrm_param_rules", "GBDTModel", "fit_gbdt", "TransformerLM",
-           "lm_loss"]
+           "lm_loss", "transformer_param_rules"]

@@ -149,3 +149,27 @@ def lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
 
     return optax.softmax_cross_entropy_with_integer_labels(
         logits[:, :-1], tokens[:, 1:]).mean()
+
+
+def transformer_param_rules(axis: str = "tensor"):
+    """Megatron-style tensor-parallel sharding rules for :class:`TransformerLM`
+    (for ``FlaxEstimator(param_rules=...)`` / ``param_sharding_rules``).
+
+    Column-parallel up-projections (q/k/v over heads, gate/up over hidden) and
+    row-parallel down-projections (o, down) — GSPMD then inserts exactly one
+    all-reduce per attention block and one per MLP block, the classic split.
+    Embedding and lm_head shard over the vocab/feature dim. The ``tensor``
+    axis should be innermost on hardware so these per-layer collectives ride
+    the fastest ICI links (raydp_tpu/parallel/mesh.py axis order).
+    """
+    return [
+        ("attn/q/kernel", (None, axis, None)),
+        ("attn/k/kernel", (None, axis, None)),
+        ("attn/v/kernel", (None, axis, None)),
+        ("attn/o/kernel", (axis, None, None)),
+        ("gate/kernel", (None, axis)),
+        ("up/kernel", (None, axis)),
+        ("down/kernel", (axis, None)),
+        ("embed/embedding", (None, axis)),
+        ("lm_head/kernel", (None, axis)),
+    ]
