@@ -7,8 +7,10 @@ lives in VMEM scratch and carries across k steps — the [T, T] score matrix
 never exists, each program touches one ``[blk_q, D] × [blk_k, D]`` tile pair on
 the MXU. The kernel also emits the log-sum-exp per query row, which makes the
 backward pass a pure recompute: ``custom_vjp`` re-forms each score block from
-(Q, K, LSE) and applies the closed-form flash gradients blockwise under
-``lax.scan`` — memory stays O(T·blk) in both directions.
+(Q, K, LSE). On TPU the backward is two Pallas kernels (dk/dv walking q
+blocks, dq walking k blocks, both with the causal block skip); elsewhere a
+blockwise ``lax.scan`` computes the same math — memory stays O(T·blk) in both
+directions.
 
 Dispatch: on TPU (and block-aligned shapes) the Pallas kernel runs; elsewhere a
 fused jnp path computes the same math (tests compare both, and run the kernel
@@ -29,7 +31,8 @@ from jax import lax
 
 # swept on TPU v5e at T=8192, H=8, D=64 (benchmarks/flash_block_sweep.py,
 # 2026-07-30): fwd 9.1ms @128x128 -> 1.23ms @1024x1024 (55.9 TFLOP/s);
-# fwd+bwd flat within 3% across 256..1024, so the fwd winner decides.
+# fwd+bwd with the Pallas backward kernels 2.41ms @512x1024 vs 2.44ms
+# @1024x1024 (~100 TFLOP/s, within 1.5%) — the fwd winner decides.
 # 2048-wide blocks gain nothing (and 2048x2048 fails VMEM).
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
@@ -66,11 +69,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             preferred_element_type=jnp.float32) * scale     # [blk_q, blk_k]
 
         if causal:
-            q_pos = qi * blk_q + lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 0)
-            k_pos = ki * blk_k + lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _mask_causal(s, qi, ki, blk_q, blk_k)
 
         m_prev = m_scr[:, 0]                                # [blk_q]
         l_prev = l_scr[:, 0]
@@ -158,6 +157,172 @@ def _fwd_jnp(q3, k3, v3, *, scale: float, causal: bool):
 
 
 # ---------------------------------------------------------------------------
+# Pallas backward kernels: recompute p from (q, k, lse), causal block skip.
+# Split in the standard way — one kernel accumulates dk/dv walking q blocks,
+# one accumulates dq walking k blocks — so each output block is written once
+# and all accumulation stays in VMEM scratch.
+# ---------------------------------------------------------------------------
+def _mask_causal(s, qi, ki, blk_q: int, blk_k: int):
+    """Apply the causal mask to a score block (shared by fwd + both bwds)."""
+    q_pos = qi * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+    k_pos = ki * blk_k + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+def _recompute_p_ds(q, k, v, do, lse, delta, qi, ki,
+                    *, scale: float, causal: bool, blk_q: int, blk_k: int):
+    """Re-form a score block from (q, k, lse) and compute (p, ds) — the flash
+    backward identity ds = p ⊙ (do·vᵀ − delta)·scale, shared by the dk/dv and
+    dq kernels so forward and backward masking cannot desynchronize."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale       # [blk_q, blk_k]
+    if causal:
+        s = _mask_causal(s, qi, ki, blk_q, blk_k)
+    p = jnp.exp(s - lse[:, None])                         # true softmax rows
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None]) * scale
+    return p, ds
+
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_scr, dv_scr,
+                     *, scale: float, causal: bool, blk_q: int, blk_k: int):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    num_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _body():
+        q, do = q_ref[0], do_ref[0]            # [blk_q, D]
+        p, ds = _recompute_p_ds(
+            q, k_ref[0], v_ref[0], do, lse_ref[0, 0], delta_ref[0, 0],
+            qi, ki, scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(qi * blk_q + (blk_q - 1) >= ki * blk_k)(_body)
+    else:
+        _body()
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr,
+                   *, scale: float, causal: bool, blk_q: int, blk_k: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _body():
+        k = k_ref[0]
+        _, ds = _recompute_p_ds(
+            q_ref[0], k, v_ref[0], do_ref[0], lse_ref[0, 0], delta_ref[0, 0],
+            qi, ki, scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(qi * blk_q + (blk_q - 1) >= ki * blk_k)(_body)
+    else:
+        _body()
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_pallas(res, g, *, scale: float, causal: bool, blk_q: int,
+                blk_k: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q3, k3, v3, out, lse = res
+    bh, t, d = q3.shape
+    do = g
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(bh, 1, t)
+    lse3 = lse.reshape(bh, 1, t)
+    num_q, num_k = t // blk_q, t // blk_k
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
+                          blk_q=blk_q, blk_k=blk_k),
+        grid=(bh, num_k, num_q),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, ki, qi: (b, qi, 0)),  # q
+            pl.BlockSpec((1, blk_k, d), lambda b, ki, qi: (b, ki, 0)),  # k
+            pl.BlockSpec((1, blk_k, d), lambda b, ki, qi: (b, ki, 0)),  # v
+            pl.BlockSpec((1, blk_q, d), lambda b, ki, qi: (b, qi, 0)),  # do
+            pl.BlockSpec((1, 1, blk_q), lambda b, ki, qi: (b, 0, qi)),  # lse
+            pl.BlockSpec((1, 1, blk_q), lambda b, ki, qi: (b, 0, qi)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, d), jnp.float32),
+            pltpu.VMEM((blk_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, k3, v3, do, lse3, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          blk_q=blk_q, blk_k=blk_k),
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, qi, ki: (b, qi, 0)),  # q
+            pl.BlockSpec((1, blk_k, d), lambda b, qi, ki: (b, ki, 0)),  # k
+            pl.BlockSpec((1, blk_k, d), lambda b, qi, ki: (b, ki, 0)),  # v
+            pl.BlockSpec((1, blk_q, d), lambda b, qi, ki: (b, qi, 0)),  # do
+            pl.BlockSpec((1, 1, blk_q), lambda b, qi, ki: (b, 0, qi)),  # lse
+            pl.BlockSpec((1, 1, blk_q), lambda b, qi, ki: (b, 0, qi)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, qi, ki: (b, qi, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q3.dtype)],
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q3, k3, v3, do, lse3, delta)[0]
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
 # Blockwise backward (flash recompute from LSE), shared by both paths
 # ---------------------------------------------------------------------------
 def _bwd_blockwise(res, g, *, scale: float, causal: bool, blk_k: int):
@@ -238,6 +403,10 @@ def _flash_fwd(q3, k3, v3, scale, causal, blk_q, blk_k, interpret):
 
 
 def _flash_bwd(scale, causal, blk_q, blk_k, interpret, res, g):
+    t, d = res[0].shape[1], res[0].shape[2]
+    if _use_pallas(t, d, blk_q, blk_k, interpret):
+        return _bwd_pallas(res, g, scale=scale, causal=causal,
+                           blk_q=blk_q, blk_k=blk_k, interpret=interpret)
     return _bwd_blockwise(res, g, scale=scale, causal=causal, blk_k=blk_k)
 
 
