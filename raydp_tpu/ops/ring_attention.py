@@ -9,9 +9,19 @@ neighbor links — the layout the hardware gives ring ``ppermute`` for free.
 
 The reference framework has no sequence parallelism at all (SURVEY.md §2.4: "every
 other strategy is absent") — this op is the long-context capability the TPU build
-adds. Local block attention is a fused online-softmax update in plain jnp (one
-[B, H, T/n, T/n] score block per ring step); the single-device memory-efficient
-kernel lives separately in :mod:`raydp_tpu.ops.flash_attention`.
+adds. Two properties keep it viable at pod scale:
+
+- **bounded local memory**: within a ring step the passing K/V block is folded
+  in ``chunk_size`` key chunks (inner ``lax.scan``), so the largest live score
+  block is [B, H, T/n, chunk] — without it a 128k-token sequence over 16
+  devices would materialize 8k x 8k scores per head per step;
+- **causal step skipping**: a block arriving from a strictly-future source
+  contributes nothing under causality; ``lax.cond`` skips its entire update
+  (the ``ppermute`` still runs — the ring must keep rotating), saving ~half
+  the FLOPs the way the flash kernel skips whole blocks above the triangle.
+
+The single-device memory-efficient kernel lives separately in
+:mod:`raydp_tpu.ops.flash_attention`.
 """
 
 from __future__ import annotations
@@ -45,11 +55,64 @@ def _local_attention_update(q, k, v, m, l, acc, mask=None, scale=1.0):
     return m_new, l_new, acc_new
 
 
+def _fit_chunk(tk: int, chunk: int) -> int:
+    """Largest divisor of ``tk`` that is <= ``chunk`` — the memory bound must
+    hold for EVERY t_local, not just multiples of the requested chunk (e.g.
+    t_local=6250 with chunk 2048 folds in 1250-key chunks, never whole)."""
+    for c in range(min(chunk, tk), 0, -1):
+        if tk % c == 0:
+            return c
+    return tk
+
+
+def _folded_block_update(q, k_blk, v_blk, m, l, acc, q_positions, k_pos0,
+                         scale: float, causal: bool, chunk: Optional[int]):
+    """Fold one K/V block into (m, l, acc), ``chunk`` keys at a time."""
+    b, tk, h, d = k_blk.shape
+
+    def whole(m, l, acc):
+        if causal:
+            k_positions = k_pos0 + jnp.arange(tk)
+            mask = (q_positions[:, None] >= k_positions[None, :])[None, None]
+        else:
+            mask = None
+        return _local_attention_update(q, k_blk.astype(jnp.float32),
+                                       v_blk.astype(jnp.float32),
+                                       m, l, acc, mask=mask, scale=scale)
+
+    if chunk is None or chunk >= tk:
+        return whole(m, l, acc)
+    chunk = _fit_chunk(tk, chunk)
+
+    n = tk // chunk
+    kc = k_blk.reshape(b, n, chunk, h, d).transpose(1, 0, 2, 3, 4)
+    vc = v_blk.reshape(b, n, chunk, h, d).transpose(1, 0, 2, 3, 4)
+
+    def inner(carry, xs):
+        m, l, acc = carry
+        k_c, v_c, i = xs
+        if causal:
+            k_positions = k_pos0 + i * chunk + jnp.arange(chunk)
+            mask = (q_positions[:, None] >= k_positions[None, :])[None, None]
+        else:
+            mask = None
+        m, l, acc = _local_attention_update(
+            q, k_c.astype(jnp.float32), v_c.astype(jnp.float32),
+            m, l, acc, mask=mask, scale=scale)
+        return (m, l, acc), None
+
+    (m, l, acc), _ = lax.scan(inner, (m, l, acc), (kc, vc, jnp.arange(n)))
+    return m, l, acc
+
+
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   chunk_size: Optional[int] = 2048):
     """Exact attention for sequence-sharded q/k/v; call inside ``shard_map``.
 
     Shapes per device: q, k, v = [B, T_local, H, D]. Returns [B, T_local, H, D].
+    ``chunk_size`` caps the live score block at [B, H, T_local, chunk_size]
+    (None = fold each arriving block in one piece).
     """
     axis_size = lax.psum(1, axis_name)
     my_index = lax.axis_index(axis_name)
@@ -76,19 +139,27 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
+    qf = q.astype(jnp.float32)
+
     def step(carry, step_idx):
         m, l, acc, k_blk, v_blk = carry
         # the block currently on this device originated at (my_index - step)
         src = (my_index - step_idx) % axis_size
-        k_positions = src * t_local + jnp.arange(t_local)
+        k_pos0 = src * t_local
+
+        def update(args):
+            m, l, acc = args
+            return _folded_block_update(qf, k_blk, v_blk, m, l, acc,
+                                        q_positions, k_pos0, scale, causal,
+                                        chunk_size)
+
         if causal:
-            mask = q_positions[:, None] >= k_positions[None, :]  # [Tq, Tk]
-            mask = mask[None, None, :, :]
+            # a block from a strictly-future source is fully masked: skip the
+            # whole update (the rotation below still runs)
+            m, l, acc = lax.cond(src <= my_index, update,
+                                 lambda args: args, (m, l, acc))
         else:
-            mask = None
-        m, l, acc = _local_attention_update(
-            q.astype(jnp.float32), k_blk.astype(jnp.float32),
-            v_blk.astype(jnp.float32), m, l, acc, mask=mask, scale=scale)
+            m, l, acc = update((m, l, acc))
         # rotate K/V to the next neighbor (overlaps with next local compute
         # when XLA schedules the collective-permute asynchronously)
         k_next = lax.ppermute(k_blk, axis_name, perm)
@@ -104,7 +175,8 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
 
 def ring_attention_sharded(q, k, v, mesh, causal: bool = True,
                            seq_axis: str = "seq", batch_axes=("data", "fsdp"),
-                           head_axis: str = "tensor"):
+                           head_axis: str = "tensor",
+                           chunk_size: Optional[int] = 2048):
     """shard_map wrapper: [B, T, H, D] arrays sharded (batch over data axes,
     sequence over ``seq_axis``, heads over ``head_axis`` when present) → same
     sharding out. Ring + head sharding compose: each (seq, tensor) tile ships
@@ -122,7 +194,8 @@ def ring_attention_sharded(q, k, v, mesh, causal: bool = True,
                           and mesh.shape[head_axis] > 1) else None
     spec = P(bspec, seq_axis, hspec, None)
 
-    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal,
+                           chunk_size=chunk_size)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec)(q, k, v)
 
