@@ -55,22 +55,15 @@ def _local_attention_update(q, k, v, m, l, acc, mask=None, scale=1.0):
     return m_new, l_new, acc_new
 
 
-def _fit_chunk(tk: int, chunk: int) -> int:
-    """Largest divisor of ``tk`` that is <= ``chunk`` — the memory bound must
-    hold for EVERY t_local, not just multiples of the requested chunk (e.g.
-    t_local=6250 with chunk 2048 folds in 1250-key chunks, never whole)."""
-    for c in range(min(chunk, tk), 0, -1):
-        if tk % c == 0:
-            return c
-    return tk
-
-
 def _folded_block_update(q, k_blk, v_blk, m, l, acc, q_positions, k_pos0,
                          scale: float, causal: bool, chunk: Optional[int]):
-    """Fold one K/V block into (m, l, acc), ``chunk`` keys at a time."""
+    """Fold one K/V block into (m, l, acc), ``chunk`` keys at a time. The
+    key dim is zero-padded up to a chunk multiple and the pad keys masked
+    out, so the memory bound holds for EVERY t_local (a prime t_local does
+    not degenerate into single-key chunks)."""
     b, tk, h, d = k_blk.shape
 
-    def whole(m, l, acc):
+    if chunk is None or chunk >= tk:
         if causal:
             k_positions = k_pos0 + jnp.arange(tk)
             mask = (q_positions[:, None] >= k_positions[None, :])[None, None]
@@ -80,25 +73,25 @@ def _folded_block_update(q, k_blk, v_blk, m, l, acc, q_positions, k_pos0,
                                        v_blk.astype(jnp.float32),
                                        m, l, acc, mask=mask, scale=scale)
 
-    if chunk is None or chunk >= tk:
-        return whole(m, l, acc)
-    chunk = _fit_chunk(tk, chunk)
-
-    n = tk // chunk
+    n = -(-tk // chunk)                    # ceil: ragged tail padded + masked
+    pad = n * chunk - tk
+    if pad:
+        k_blk = jnp.pad(k_blk, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v_blk = jnp.pad(v_blk, ((0, 0), (0, pad), (0, 0), (0, 0)))
     kc = k_blk.reshape(b, n, chunk, h, d).transpose(1, 0, 2, 3, 4)
     vc = v_blk.reshape(b, n, chunk, h, d).transpose(1, 0, 2, 3, 4)
 
     def inner(carry, xs):
         m, l, acc = carry
         k_c, v_c, i = xs
+        offsets = i * chunk + jnp.arange(chunk)
+        valid = (offsets < tk)[None, :]                       # mask pad keys
         if causal:
-            k_positions = k_pos0 + i * chunk + jnp.arange(chunk)
-            mask = (q_positions[:, None] >= k_positions[None, :])[None, None]
-        else:
-            mask = None
+            k_positions = k_pos0 + offsets
+            valid = valid & (q_positions[:, None] >= k_positions[None, :])
         m, l, acc = _local_attention_update(
             q, k_c.astype(jnp.float32), v_c.astype(jnp.float32),
-            m, l, acc, mask=mask, scale=scale)
+            m, l, acc, mask=valid[None, None], scale=scale)
         return (m, l, acc), None
 
     (m, l, acc), _ = lax.scan(inner, (m, l, acc), (kc, vc, jnp.arange(n)))
@@ -121,21 +114,14 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = True,
 
     q_positions = my_index * t_local + jnp.arange(t_local)  # global q positions
 
-    m0 = jnp.full((b, h, t_local), -jnp.inf, dtype=jnp.float32)
-    l0 = jnp.zeros((b, h, t_local), dtype=jnp.float32)
-    acc0 = jnp.zeros((b, t_local, h, d), dtype=jnp.float32)
-    if hasattr(lax, "pcast") or hasattr(lax, "pvary"):
-        # newer jax tracks varying-manual-axes through shard_map: the carry
-        # inits must vary over the same axes as the inputs they mix with
-        try:
-            vma = tuple(jax.typeof(q).vma) or (axis_name,)
-        except Exception:
-            vma = (axis_name,)
-        if hasattr(lax, "pcast"):
-            m0, l0, acc0 = (lax.pcast(x, vma, to="varying")
-                            for x in (m0, l0, acc0))
-        else:
-            m0, l0, acc0 = (lax.pvary(x, vma) for x in (m0, l0, acc0))
+    from raydp_tpu.parallel.mesh import vary_manual
+    try:
+        vma = tuple(jax.typeof(q).vma) or (axis_name,)
+    except Exception:
+        vma = (axis_name,)
+    m0 = vary_manual(jnp.full((b, h, t_local), -jnp.inf, jnp.float32), vma)
+    l0 = vary_manual(jnp.zeros((b, h, t_local), jnp.float32), vma)
+    acc0 = vary_manual(jnp.zeros((b, t_local, h, d), jnp.float32), vma)
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 

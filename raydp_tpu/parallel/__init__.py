@@ -5,9 +5,9 @@ collective stacks (SURVEY.md §2.4-2.5: torch DDP, oneCCL, TF MWMS, Horovod,
 XGBoost Rabit). The TPU-native design collapses all of them into one mechanism:
 a ``jax.sharding.Mesh`` over the pod plus in-graph XLA collectives inserted by
 ``jit`` from sharding annotations — gradients ride ICI ``psum``, not NCCL rings.
-The mesh here is multi-axis from day one (``data``/``fsdp``/``tensor``/``seq``/
-``expert``) so TP/FSDP/sequence/expert sharding are additive strategies, not
-rewrites (SURVEY.md §2.4 closing note).
+The mesh here is multi-axis from day one (``stage``/``data``/``fsdp``/
+``tensor``/``seq``/``expert``) so PP/TP/FSDP/sequence/expert sharding are
+additive strategies, not rewrites (SURVEY.md §2.4 closing note).
 """
 
 from raydp_tpu.parallel.mesh import (
@@ -18,6 +18,7 @@ from raydp_tpu.parallel.mesh import (
     param_sharding_rules,
     shard_params,
 )
+from raydp_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 
 __all__ = [
     "MeshSpec",
@@ -26,4 +27,6 @@ __all__ = [
     "replicated",
     "param_sharding_rules",
     "shard_params",
+    "pipeline_apply",
+    "stack_stage_params",
 ]

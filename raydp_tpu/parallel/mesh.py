@@ -2,6 +2,8 @@
 
 Axis convention (sizes multiply to the device count):
 
+- ``stage``   — pipeline parallel: layer stages, activations ppermute forward
+  (see :mod:`raydp_tpu.parallel.pipeline`).
 - ``data``    — data parallel: batch dim sharded, params replicated, grad psum.
 - ``fsdp``    — params+optimizer sharded over this axis, all-gathered per layer.
 - ``tensor``  — tensor parallel (Megatron-style column/row splits).
@@ -9,8 +11,10 @@ Axis convention (sizes multiply to the device count):
 - ``expert``  — expert parallel (MoE experts and DLRM embedding shards).
 
 On hardware, axis order maps inner axes to ICI neighbors — keep ``tensor``/
-``seq`` innermost so their heavy collectives ride the fastest links (the
-scaling-book recipe: pick a mesh, annotate shardings, let XLA insert collectives).
+``seq`` innermost so their heavy collectives ride the fastest links, and
+``stage`` outermost (its per-microbatch boundary hops are the rarest, and on
+multi-slice deployments they are what crosses DCN). The scaling-book recipe:
+pick a mesh, annotate shardings, let XLA insert collectives.
 """
 
 from __future__ import annotations
@@ -20,7 +24,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-AXES = ("data", "fsdp", "expert", "seq", "tensor")
+AXES = ("stage", "data", "fsdp", "expert", "seq", "tensor")
 
 
 @dataclass
@@ -32,17 +36,18 @@ class MeshSpec:
     expert: int = 1
     seq: int = 1
     tensor: int = 1
+    stage: int = 1
 
     def sizes(self, num_devices: int) -> Dict[str, int]:
         fixed = {"fsdp": self.fsdp, "expert": self.expert, "seq": self.seq,
-                 "tensor": self.tensor}
+                 "tensor": self.tensor, "stage": self.stage}
         known = int(np.prod(list(fixed.values())))
         data = self.data
         if data == -1:
             if num_devices % known != 0:
                 raise ValueError(
                     f"{num_devices} devices not divisible by "
-                    f"fsdp*expert*seq*tensor={known}")
+                    f"stage*fsdp*expert*seq*tensor={known}")
             data = num_devices // known
         total = data * known
         if total != num_devices:
@@ -64,6 +69,29 @@ def make_mesh(spec: Optional[MeshSpec] = None, devices=None,
     shape = tuple(sizes[a] for a in axis_names)
     arr = np.array(devices).reshape(shape)
     return Mesh(arr, tuple(axis_names))
+
+
+def vary_manual(x, axes: Sequence[str]):
+    """Mark ``x`` varying over the manual mesh ``axes`` it is not already
+    varying over — the newer-jax shard_map vma compat shim (carry inits made
+    with ``zeros_like`` are invariant and must be cast before mixing with
+    varying values; ``pcast`` rejects axes already in the input's vma).
+    No-op on older jax. Shared by ring attention and the pipeline."""
+    import jax
+    from jax import lax
+
+    if not axes or not (hasattr(lax, "pcast") or hasattr(lax, "pvary")):
+        return x
+    try:
+        cur = set(jax.typeof(x).vma)
+    except Exception:
+        cur = set()
+    need = tuple(a for a in axes if a not in cur)
+    if not need:
+        return x
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, need, to="varying")
+    return lax.pvary(x, need)
 
 
 def data_axes(mesh) -> Tuple[str, ...]:

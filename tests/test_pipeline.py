@@ -1,0 +1,126 @@
+"""Pipeline parallelism (GPipe over the ``stage`` mesh axis) correctness:
+the pipelined schedule must compute exactly what sequential layer application
+computes — forward and gradients — including composed with a data axis and
+with real transformer blocks as stages."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raydp_tpu.parallel import MeshSpec, make_mesh, pipeline_apply, \
+    stack_stage_params
+
+N_STAGES = 4
+N_MICRO = 6
+MB, DIM = 4, 16
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _stage_params(seed):
+    rng = np.random.RandomState(seed)
+    return {"w": jnp.asarray(rng.normal(0, 0.5, (DIM, DIM)), jnp.float32),
+            "b": jnp.asarray(rng.normal(0, 0.1, (DIM,)), jnp.float32)}
+
+
+def _sequential(stacked, x_micro):
+    def one(x):
+        for i in range(N_STAGES):
+            x = _stage_fn(jax.tree.map(lambda p: p[i], stacked), x)
+        return x
+    return jax.vmap(one)(x_micro)
+
+
+@pytest.fixture
+def stacked():
+    return stack_stage_params([_stage_params(i) for i in range(N_STAGES)])
+
+
+@pytest.fixture
+def x_micro():
+    rng = np.random.RandomState(42)
+    return jnp.asarray(rng.normal(size=(N_MICRO, MB, DIM)), jnp.float32)
+
+
+def test_pipeline_matches_sequential(stacked, x_micro):
+    mesh = make_mesh(MeshSpec(stage=N_STAGES))
+    got = pipeline_apply(_stage_fn, stacked, x_micro, mesh)
+    ref = _sequential(stacked, x_micro)
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_pipeline_grads_match_sequential(stacked, x_micro):
+    """AD through scan+ppermute IS the reverse pipeline: gradients w.r.t.
+    every stage's params match the sequential model's."""
+    mesh = make_mesh(MeshSpec(stage=N_STAGES))
+
+    def loss_pp(p):
+        return jnp.sum(pipeline_apply(_stage_fn, p, x_micro, mesh) ** 2)
+
+    def loss_seq(p):
+        return jnp.sum(_sequential(p, x_micro) ** 2)
+
+    g_pp = jax.grad(loss_pp)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-5),
+        g_pp, g_seq)
+
+
+def test_pipeline_composes_with_data_axis(stacked, x_micro):
+    """pp x dp: stage=4 by data=2 on the 8-device mesh; microbatches sharded
+    over data on their batch dim still produce the sequential answer."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh(MeshSpec(stage=N_STAGES, data=2))
+    xs = jax.device_put(x_micro, NamedSharding(mesh, P(None, "data")))
+    got = pipeline_apply(_stage_fn, stacked, xs, mesh)
+    ref = _sequential(stacked, x_micro)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_pipeline_no_stage_axis_is_sequential(stacked, x_micro):
+    mesh = make_mesh(MeshSpec())      # stage=1
+    got = pipeline_apply(_stage_fn, stacked, x_micro, mesh)
+    ref = _sequential(stacked, x_micro)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_pipeline_transformer_blocks():
+    """Real transformer Blocks as stages (dense attention, shape-uniform):
+    2-stage pipeline over 8 devices vs the same blocks applied in order."""
+    from raydp_tpu.models.transformer import Block
+
+    dim, heads, t, mb, n_micro, n_stages = 32, 2, 16, 2, 3, 2
+    mesh = make_mesh(MeshSpec(stage=n_stages))
+    block = Block(num_heads=heads, attention="dense")
+    rng = np.random.RandomState(0)
+    x_micro = jnp.asarray(rng.normal(size=(n_micro, mb, t, dim)) * 0.3,
+                          jnp.float32)
+
+    stage_trees = [
+        block.init(jax.random.PRNGKey(i), x_micro[0])["params"]
+        for i in range(n_stages)
+    ]
+    stacked = stack_stage_params(stage_trees)
+
+    def fn(params, x):
+        return block.apply({"params": params}, x)
+
+    got = pipeline_apply(fn, stacked, x_micro, mesh)
+
+    def one(x):
+        for tree in stage_trees:
+            x = fn(tree, x)
+        return x
+    ref = jax.vmap(one)(x_micro)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

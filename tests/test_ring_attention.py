@@ -36,15 +36,17 @@ def test_ring_full_seq8():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("chunk", [4, 5])      # 5 does not divide 16: ragged
 @pytest.mark.parametrize("causal", [True, False])
-def test_ring_chunked_matches_dense(causal):
+def test_ring_chunked_matches_dense(causal, chunk):
     """chunk_size smaller than the local block: the inner k-chunk scan (the
     pod-scale memory bound) and the causal step skip must not change the
-    math — 16 rows/device folded 4 keys at a time."""
+    math — 16 rows/device folded a few keys at a time, including a ragged
+    (padded + masked) final chunk."""
     mesh = make_mesh(MeshSpec(data=2, seq=4))
     q, k, v = _qkv(b=2, t=64, h=2, d=16, seed=7)
     out_ring = ring_attention_sharded(q, k, v, mesh, causal=causal,
-                                      chunk_size=4)
+                                      chunk_size=chunk)
     out_dense = dense_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_dense),
                                atol=2e-5, rtol=2e-5)
