@@ -284,7 +284,12 @@ def _lm_mode_run(mode: str, T: int) -> dict:
 
     from raydp_tpu.models import TransformerLM, lm_loss
 
-    dim, heads, layers, vocab = 512, 8, 4, 32768
+    dim = int(os.environ.get("BENCH_LM_DIM", "512"))
+    if dim % 64:
+        raise SystemExit("BENCH_LM_DIM must be a multiple of 64 "
+                         "(64-wide heads)")
+    layers = int(os.environ.get("BENCH_LM_LAYERS", "4"))
+    heads, vocab = dim // 64, 32768
     B = int(os.environ.get("BENCH_LM_BATCH", "2"))
     steps = int(os.environ.get("BENCH_LM_STEPS", "8"))
     rng = np.random.RandomState(0)

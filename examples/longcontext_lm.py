@@ -35,6 +35,8 @@ def main():
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--seq-parallel", type=int, default=0,
                    help="devices on the seq axis (0 = all devices)")
+    p.add_argument("--tensor-parallel", type=int, default=1,
+                   help="devices on the tensor axis (Megatron param split)")
     args = p.parse_args()
 
     import jax
@@ -46,12 +48,15 @@ def main():
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from raydp_tpu.models import TransformerLM, lm_loss
-    from raydp_tpu.parallel import MeshSpec, make_mesh
+    from raydp_tpu.models import TransformerLM, lm_loss, \
+        transformer_param_rules
+    from raydp_tpu.parallel import MeshSpec, make_mesh, param_sharding_rules
 
     n_dev = len(jax.devices())
-    seq_par = args.seq_parallel or n_dev
-    mesh = make_mesh(MeshSpec(data=n_dev // seq_par, seq=seq_par))
+    tp = args.tensor_parallel
+    seq_par = args.seq_parallel or n_dev // tp
+    mesh = make_mesh(MeshSpec(data=n_dev // (seq_par * tp), seq=seq_par,
+                              tensor=tp))
     print(f"devices={n_dev} mesh={dict(mesh.shape)}")
 
     model = TransformerLM(vocab_size=args.vocab, dim=args.dim,
@@ -67,7 +72,15 @@ def main():
 
     variables = model.init(jax.random.PRNGKey(0), tokens)
     tx = optax.adamw(3e-4)
-    opt_state = tx.init(variables["params"])
+    params = variables["params"]
+    opt_state = tx.init(params)
+    if tp > 1:
+        # Megatron split: q/k/v + gate/up column-parallel, o/down row-parallel
+        shardings_of = param_sharding_rules(mesh,
+                                            transformer_param_rules("tensor"))
+        params = jax.tree.map(jax.device_put, params, shardings_of(params))
+        opt_state = jax.tree.map(jax.device_put, opt_state,
+                                 shardings_of(opt_state))
 
     @jax.jit
     def step(params, opt_state, batch):
@@ -77,7 +90,6 @@ def main():
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    params = variables["params"]
     with mesh:
         t0 = time.perf_counter()
         for i in range(args.steps):

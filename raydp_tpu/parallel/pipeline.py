@@ -35,12 +35,21 @@ def stack_stage_params(param_trees) -> Any:
 
 def _pipeline_local(stage_params, x_micro, *, fn, stage_axis: str,
                     n_micro: int):
-    """Per-stage body under shard_map. ``stage_params`` leaves arrive with a
-    leading axis of 1 (this stage's slice); ``x_micro`` is replicated
-    [n_micro, ...]."""
+    """Per-stage body under shard_map. ``stage_params`` leaves arrive with
+    leading axis ``layers_per_stage`` (this stage's contiguous slice of the
+    layer stack); ``x_micro`` is [n_micro, ...] (batch dim possibly
+    data-sharded)."""
     n_stages = lax.psum(1, stage_axis)
     s = lax.axis_index(stage_axis)
-    params = jax.tree.map(lambda p: p[0], stage_params)
+    # this stage's shard holds its CONTIGUOUS run of layers (leading dim =
+    # layers_per_stage); apply them in order — one stage may own several
+    layers_per_stage = jax.tree.leaves(stage_params)[0].shape[0]
+
+    def apply_stage(x):
+        for i in range(layers_per_stage):
+            x = fn(jax.tree.map(lambda p: p[i], stage_params), x)
+        return x
+
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     # carry inits must vary over the union of the manual axes of everything
@@ -61,7 +70,7 @@ def _pipeline_local(stage_params, x_micro, *, fn, stage_axis: str,
         # consume the activation that arrived from stage-1 on the last hop
         inject = x_micro[jnp.clip(t, 0, n_micro - 1)]
         cur = jnp.where(s == 0, inject, state)
-        y = fn(params, cur)
+        y = apply_stage(cur)
         # the last stage finished microbatch (t - (n_stages - 1))
         idx = t - (n_stages - 1)
         live = (s == n_stages - 1) & (idx >= 0)
@@ -84,8 +93,10 @@ def pipeline_apply(fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     stages; ``fn(params, x) -> y`` is one stage (y must have x's shape/dtype —
     stage-homogeneous pipelines, the transformer-block case).
 
-    ``stage_params`` leaves are stacked [n_stages, ...]
-    (:func:`stack_stage_params`) and sharded over ``stage_axis``; returns
+    ``stage_params`` leaves are stacked [n_layers, ...]
+    (:func:`stack_stage_params`; ``n_layers`` must be a multiple of
+    ``n_stages`` — each stage applies its contiguous run of layers in order)
+    and sharded over ``stage_axis``; returns
     [n_micro, mb, ...] outputs, replicated over the stage axis. The
     microbatch dim (axis 1) is sharded over the mesh's data axes inside the
     pipeline, so pp×dp does dp-partitioned work per stage rather than
@@ -104,6 +115,12 @@ def pipeline_apply(fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
 
     n_stages = mesh.shape[stage_axis]
     n_micro = int(x_micro.shape[0])
+    n_layers = stage_params_leading_dim(stage_params)
+    if n_stages > 1 and n_layers % n_stages != 0:
+        raise ValueError(
+            f"{n_layers} stacked layers cannot split over {n_stages} pipeline "
+            f"stages (must divide evenly; each stage applies its contiguous "
+            f"run of layers in order)")
     if n_stages <= 1:
         # no stage axis: plain sequential application of every stage
         def seq_apply(x):

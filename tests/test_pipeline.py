@@ -86,6 +86,30 @@ def test_pipeline_composes_with_data_axis(stacked, x_micro):
                                atol=1e-6, rtol=1e-6)
 
 
+def test_pipeline_multiple_layers_per_stage(x_micro):
+    """8 stacked layers over 4 stages: each stage applies its contiguous pair
+    in order — must equal plain sequential application of all 8."""
+    n_layers = 8
+    stacked8 = stack_stage_params([_stage_params(i) for i in range(n_layers)])
+    mesh = make_mesh(MeshSpec(stage=N_STAGES))
+    got = pipeline_apply(_stage_fn, stacked8, x_micro, mesh)
+
+    def one(x):
+        for i in range(n_layers):
+            x = _stage_fn(jax.tree.map(lambda p: p[i], stacked8), x)
+        return x
+    ref = jax.vmap(one)(x_micro)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_pipeline_rejects_indivisible_layer_count(stacked, x_micro):
+    mesh = make_mesh(MeshSpec(stage=N_STAGES))
+    bad = stack_stage_params([_stage_params(i) for i in range(N_STAGES + 1)])
+    with pytest.raises(ValueError, match="must divide"):
+        pipeline_apply(_stage_fn, bad, x_micro, mesh)
+
+
 def test_pipeline_no_stage_axis_is_sequential(stacked, x_micro):
     mesh = make_mesh(MeshSpec())      # stage=1
     got = pipeline_apply(_stage_fn, stacked, x_micro, mesh)
