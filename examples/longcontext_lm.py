@@ -50,10 +50,12 @@ def main():
 
     from raydp_tpu.models import TransformerLM, lm_loss, \
         transformer_param_rules
-    from raydp_tpu.parallel import MeshSpec, make_mesh, param_sharding_rules
+    from raydp_tpu.parallel import MeshSpec, make_mesh, shard_params
 
     n_dev = len(jax.devices())
     tp = args.tensor_parallel
+    if tp < 1:
+        raise SystemExit("--tensor-parallel must be >= 1")
     seq_par = args.seq_parallel or n_dev // tp
     mesh = make_mesh(MeshSpec(data=n_dev // (seq_par * tp), seq=seq_par,
                               tensor=tp))
@@ -76,11 +78,9 @@ def main():
     opt_state = tx.init(params)
     if tp > 1:
         # Megatron split: q/k/v + gate/up column-parallel, o/down row-parallel
-        shardings_of = param_sharding_rules(mesh,
-                                            transformer_param_rules("tensor"))
-        params = jax.tree.map(jax.device_put, params, shardings_of(params))
-        opt_state = jax.tree.map(jax.device_put, opt_state,
-                                 shardings_of(opt_state))
+        rules = transformer_param_rules("tensor")
+        params = shard_params(params, mesh, rules)
+        opt_state = shard_params(opt_state, mesh, rules)
 
     @jax.jit
     def step(params, opt_state, batch):

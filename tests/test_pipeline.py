@@ -103,7 +103,7 @@ def test_pipeline_multiple_layers_per_stage(x_micro):
                                atol=1e-6, rtol=1e-6)
 
 
-def test_pipeline_rejects_indivisible_layer_count(stacked, x_micro):
+def test_pipeline_rejects_indivisible_layer_count(x_micro):
     mesh = make_mesh(MeshSpec(stage=N_STAGES))
     bad = stack_stage_params([_stage_params(i) for i in range(N_STAGES + 1)])
     with pytest.raises(ValueError, match="must divide"):
