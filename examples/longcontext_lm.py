@@ -54,8 +54,9 @@ def main():
 
     n_dev = len(jax.devices())
     tp = args.tensor_parallel
-    if tp < 1:
-        raise SystemExit("--tensor-parallel must be >= 1")
+    if tp < 1 or n_dev % tp:
+        raise SystemExit(f"--tensor-parallel must be >= 1 and divide the "
+                         f"device count ({n_dev})")
     seq_par = args.seq_parallel or n_dev // tp
     mesh = make_mesh(MeshSpec(data=n_dev // (seq_par * tp), seq=seq_par,
                               tensor=tp))

@@ -114,6 +114,68 @@ def test_agent_death_reroutes_restartable_actor(runtime):
             pass
 
 
+def test_fit_gang_trains_through_node_agent(session, tmp_path):
+    """The full multi-node training path: a 2-rank FlaxEstimator gang where
+    one rank spawns on a node agent (SPREAD placement) — the remote rank
+    joins jax.distributed via the published coordinator and reads its data
+    shard over the cross-host store RPC. Losses must match the local run."""
+    import numpy as np
+    import optax
+    import pandas as pd
+
+    from raydp_tpu.data.dataset import from_frame
+    from raydp_tpu.models import MLP
+    from raydp_tpu.runtime import get_runtime
+    from raydp_tpu.train import FlaxEstimator
+
+    rt = get_runtime()
+    agent = _start_agent(rt.server.url, cpus=4.0)
+    try:
+        _wait_nodes(rt, 2)
+
+        rng = np.random.RandomState(0)
+        x = rng.random_sample((1024, 2))
+        y = x @ np.array([2.0, -3.0]) + 1.0
+        df = session.createDataFrame(
+            pd.DataFrame({"x1": x[:, 0], "x2": x[:, 1], "y": y}),
+            num_partitions=4)
+        ds = from_frame(df)
+
+        marker_dir = str(tmp_path)
+
+        def record_parent(report):
+            # runs inside every rank once per epoch: record who spawned us
+            path = os.path.join(marker_dir, f"ppid-{os.getpid()}")
+            with open(path, "w") as f:
+                f.write(str(os.getppid()))
+
+        def make_est(callbacks=None):
+            return FlaxEstimator(
+                model=MLP(features=(8,), use_batch_norm=False),
+                optimizer=optax.sgd(5e-2), loss="mse",
+                feature_columns=["x1", "x2"], label_column="y",
+                batch_size=64, num_epochs=2, shuffle=False,
+                callbacks=callbacks)
+
+        r_local = make_est().fit(ds)
+        r_gang = make_est([record_parent]).fit_gang(ds, num_workers=2,
+                                                    run_timeout=900.0)
+
+        np.testing.assert_allclose(
+            [h["train_loss"] for h in r_gang.history],
+            [h["train_loss"] for h in r_local.history], rtol=2e-4)
+        # one rank ran under the agent, one locally (SPREAD over 2 nodes)
+        ppids = {int(open(os.path.join(marker_dir, f)).read())
+                 for f in os.listdir(marker_dir) if f.startswith("ppid-")}
+        assert agent.pid in ppids, (ppids, agent.pid)
+        assert os.getpid() in ppids
+    finally:
+        try:
+            os.killpg(agent.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
 def test_spmd_ranks_spawn_on_agent_nodes(runtime):
     """A gang with SPREAD placement fans its ranks out across node agents —
     one rank process per machine, mpirun-hosts style."""
