@@ -74,6 +74,12 @@ def _steady(history):
     return sum(r["samples_per_s"] for r in rows) / len(rows)
 
 
+# steady-state averages over epochs[1:]: anything fewer than 3 epochs leaves
+# a single-epoch window, whose numbers swing ~4x between runs on a loaded
+# host/tunnel
+STEADY_EPOCHS = max(3, EPOCHS // 2 + 1)
+
+
 # --------------------------------------------------------------------- nyctaxi
 def bench_nyctaxi() -> dict:
     import optax
@@ -149,7 +155,7 @@ def bench_dlrm() -> dict:
             label_column=LABEL,
             feature_dtype=np.float64,
             batch_size=min(4096, BATCH),
-            num_epochs=max(2, EPOCHS // 2),
+            num_epochs=STEADY_EPOCHS,
             batch_preprocessor=criteo_batch_preprocessor(NUM_DENSE),
         )
         result = est.fit_on_frame(df)
@@ -188,7 +194,7 @@ def bench_keras() -> dict:
                 keras.layers.Dense(1),
             ])
 
-        epochs = max(3, EPOCHS // 2 + 1)
+        epochs = STEADY_EPOCHS
         est = KerasEstimator(
             model_builder=build, optimizer="adam", loss="mse",
             feature_columns=features, label_column=LABEL,
