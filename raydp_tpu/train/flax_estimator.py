@@ -47,6 +47,17 @@ class TrainingResult:
         return self.history[-1] if self.history else {}
 
 
+def _takes_train(model) -> bool:
+    """Does the module's __call__ accept a ``train`` kwarg (dropout/BN mode)?
+    Shared by the train loop and predict so both pass the same kwargs."""
+    import inspect
+
+    try:
+        return "train" in inspect.signature(type(model).__call__).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 def _resolve_loss(loss) -> Callable:
     import jax.numpy as jnp
 
@@ -222,18 +233,11 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         metrics = self._metrics
 
         # ---- init params from one host batch's shapes ----
-        import inspect
-
         first = next(iter(feed.host_iter))
         inputs0, _ = self._split_batch(
             {k: jnp.asarray(v[:1]) for k, v in first.items()})
         rng = jax.random.PRNGKey(self.seed)
-        takes_train = False
-        try:
-            takes_train = "train" in inspect.signature(
-                type(model).__call__).parameters
-        except (TypeError, ValueError):
-            pass
+        takes_train = _takes_train(model)
         init_kwargs = {"train": False} if takes_train else {}
         variables = model.init(rng, inputs0, **init_kwargs)
         batch_stats = variables.get("batch_stats")
@@ -547,6 +551,51 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
             return self.fit_gang(train_ds, eval_ds, num_workers=num_workers,
                                  max_retries=max_retries)
         return self.fit(train_ds, eval_ds, max_retries=max_retries)
+
+    # ---------------------------------------------------------------- predict
+    def predict(self, ds, batch_size: Optional[int] = None) -> np.ndarray:
+        """Run the trained model over a dataset's feature columns and return
+        predictions as one host array (row order = dataset block order).
+
+        Convenience beyond the reference (whose users rebuild an inference
+        loop around ``get_model``); models with a custom
+        ``batch_preprocessor`` consuming labels are not supported here —
+        apply ``get_model`` manually for those.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from raydp_tpu.data.feed import HostBatchIterator
+
+        if self.batch_preprocessor is not None or self.columns_spec is not None:
+            raise NotImplementedError(
+                "predict() supports the feature_columns path; apply "
+                "get_model() manually for batch_preprocessor / columns_spec "
+                "models")
+        model = self._build_model()
+        variables = self.get_model()   # raises if fit() has not run
+        kwargs = {"train": False} if _takes_train(model) else {}
+
+        compute_dtype = self.compute_dtype
+
+        @jax.jit
+        def infer(inputs):
+            if compute_dtype is not None and jnp.issubdtype(
+                    inputs.dtype, jnp.floating):
+                inputs = inputs.astype(compute_dtype)
+            preds = model.apply(variables, inputs, **kwargs)
+            if preds.ndim >= 2 and preds.shape[-1] == 1:
+                preds = preds.squeeze(-1)
+            return preds.astype(jnp.float32)
+
+        cols = {"features": (self.feature_columns, self.feature_dtype)}
+        it = HostBatchIterator(ds, batch_size or self.batch_size, cols,
+                               shuffle=False, drop_remainder=False)
+        out = [np.asarray(infer(jnp.asarray(batch["features"])))
+               for batch in it]
+        if not out:
+            return np.empty((0,), np.float32)
+        return np.concatenate(out, axis=0)
 
     # -------------------------------------------------------------- get_model
     def get_model(self):
