@@ -63,13 +63,22 @@ class ExecutorPool:
     (a restarted actor keeps its name at a new address).
     """
 
-    def __init__(self, executors: List[ActorHandle], max_task_retries: int = 8):
+    def __init__(self, executors: List[ActorHandle], max_task_retries: int = 8,
+                 hosts_by_name: Optional[Dict[str, str]] = None):
         if not executors:
             raise ValueError("executor pool is empty")
         self.executors = list(executors)
         self.by_name = {h.name: h for h in executors}
         self.max_task_retries = max_task_retries
+        #: executor name → data-plane host id (machine), for locality routing
+        self.hosts_by_name: Dict[str, str] = dict(hosts_by_name or {})
+        self._names_by_host: Dict[str, List[str]] = {}
+        for h in self.executors:
+            if h.name and h.name in self.hosts_by_name:
+                self._names_by_host.setdefault(
+                    self.hosts_by_name[h.name], []).append(h.name)
         self._rr = 0
+        self._local_rr: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     def _next_executor(self) -> ActorHandle:
@@ -77,6 +86,22 @@ class ExecutorPool:
             h = self.executors[self._rr % len(self.executors)]
             self._rr += 1
             return h
+
+    def multi_host(self) -> bool:
+        """True when executors span machines — only then is locality routing
+        worth overriding round-robin balance."""
+        return len(set(self.hosts_by_name.values())) > 1
+
+    def pick_local(self, host_id: str) -> Optional[str]:
+        """An executor on ``host_id`` (round-robin among that machine's
+        executors for balance), or None when none runs there."""
+        names = self._names_by_host.get(host_id)
+        if not names:
+            return None
+        with self._lock:
+            i = self._local_rr.get(host_id, 0)
+            self._local_rr[host_id] = i + 1
+        return names[i % len(names)]
 
     def run_tasks(
         self,
@@ -269,7 +294,7 @@ class Engine:
         if isinstance(node, P.InMemory):
             tasks = [self._task(T.ArrowRefSource([ref], schema=node.schema))
                      for ref in node.refs]
-            return tasks, [None] * len(tasks)
+            return tasks, self._locality([[ref] for ref in node.refs])
 
         if isinstance(node, P.CachedScan):
             tasks, preferred = [], []
@@ -336,6 +361,38 @@ class Engine:
         return T.Task(task_id=f"t-{uuid.uuid4().hex[:10]}", source=source,
                       steps=steps or [])
 
+    def _locality(self, ref_lists: Sequence[Sequence[Optional[ObjectRef]]]
+                  ) -> List[Optional[str]]:
+        """Preferred executor per ref-reading task: one on the machine holding
+        the most input bytes. One bulk ``locations`` RPC; a no-op on
+        single-machine pools so round-robin balance is untouched. Parity:
+        preferred locations from block owner addresses
+        (RayDatasetRDD.scala:48-56, RayDPExecutor.scala:271-287)."""
+        if not self.pool.multi_host():
+            return [None] * len(ref_lists)
+        try:
+            seen: Dict[str, ObjectRef] = {}
+            for refs in ref_lists:
+                for r in refs:
+                    if r is not None:
+                        seen[r.id] = r
+            locs = get_client().locations(list(seen.values()))
+        except Exception:
+            return [None] * len(ref_lists)
+        preferred: List[Optional[str]] = []
+        for refs in ref_lists:
+            weight: Dict[str, int] = {}
+            for r in refs:
+                host = locs.get(r.id) if r is not None else None
+                if host is not None:
+                    weight[host] = weight.get(host, 0) + max(r.size, 1)
+            if not weight:
+                preferred.append(None)
+                continue
+            best = max(weight, key=weight.get)
+            preferred.append(self.pool.pick_local(best))
+        return preferred
+
     def _compile_csv(self, node: P.CsvScan):
         tasks = []
         headerless = bool((node.options or {}).get("column_names"))
@@ -395,14 +452,16 @@ class Engine:
             # coalesce: group existing partitions without moving rows by key
             refs, schema, _ = self._materialize_inner(node.child, None, temps)
             temps.extend(refs)
-            groups = np.array_split(np.arange(len(refs)), n)
-            tasks = [self._task(T.ArrowRefSource([refs[i] for i in g], schema=schema))
-                     for g in groups if len(g) > 0]
-            return tasks, [None] * len(tasks)
+            groups = [[refs[i] for i in g]
+                      for g in np.array_split(np.arange(len(refs)), n)
+                      if len(g) > 0]
+            tasks = [self._task(T.ArrowRefSource(group, schema=schema))
+                     for group in groups]
+            return tasks, self._locality(groups)
         buckets, schema = self._shuffle_children(node.child, n, keys=None, temps=temps)
         tasks = [self._task(T.ArrowRefSource(bucket, schema=schema))
                  for bucket in buckets]
-        return tasks, [None] * len(tasks)
+        return tasks, self._locality(buckets)
 
     def _compile_groupagg(self, node: P.GroupAgg, temps: List[ObjectRef]):
         nb = min(self.shuffle_partitions, max(1, len(self.pool.executors) * 2))
@@ -411,7 +470,7 @@ class Engine:
         tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
                             [T.GroupAggStep(node.keys, node.aggs)])
                  for bucket in buckets]
-        return tasks, [None] * len(tasks)
+        return tasks, self._locality(buckets)
 
     def _compile_join(self, node: P.Join, temps: List[ObjectRef]):
         nb = min(self.shuffle_partitions, max(1, len(self.pool.executors) * 2))
@@ -425,7 +484,9 @@ class Engine:
                 T.ArrowRefSource(lb, schema=lschema),
                 [T.HashJoinStep(rb, node.keys, node.right_keys, node.how,
                                 right_schema=rschema)]))
-        return tasks, [None] * len(tasks)
+        # a join task reads BOTH sides' buckets: weight locality over them
+        return tasks, self._locality([list(lb) + list(rb) for lb, rb
+                                      in zip(left_buckets, right_buckets)])
 
     def _compile_sort(self, node: P.Sort, temps: List[ObjectRef]):
         """Range-partitioned sort: materialize the child ONCE, sample boundary
@@ -457,20 +518,26 @@ class Engine:
         if not sampled:
             boundaries: List = []
         else:
+            # null keys are routed to a fixed bucket, never ranged: a null
+            # boundary would poison every comparison (null > null = null)
             values = pa.concat_arrays(
-                [c.combine_chunks() for c in sampled]).sort()
+                [c.combine_chunks() for c in sampled]).drop_null().sort()
             qpos = [int(q * (len(values) - 1))
-                    for q in np.linspace(0, 1, nb + 1)[1:-1]]
+                    for q in np.linspace(0, 1, nb + 1)[1:-1]] if len(values) \
+                else []
             boundaries = []
             for p in qpos:
                 v = values[p].as_py()
                 if not boundaries or v != boundaries[-1]:
                     boundaries.append(v)
 
+        # ascending: null keys must land in the LAST bucket (sort_by is
+        # at_end); descending reverses the buckets, so nulls stay in bucket 0
         shuffle_tasks = [
             self._task(T.ArrowRefSource([ref], schema=schema)).with_output(
                 output=T.SHUFFLE, num_buckets=len(boundaries) + 1,
-                range_key=(key, boundaries), owner=self.owner)
+                range_key=(key, boundaries, order == "ascending"),
+                owner=self.owner)
             for ref in refs
         ]
         results = self.pool.run_tasks(shuffle_tasks)
@@ -484,4 +551,4 @@ class Engine:
         tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
                             [T.LocalSortStep(node.keys)])
                  for bucket in buckets]
-        return tasks, [None] * len(tasks)
+        return tasks, self._locality(buckets)

@@ -146,8 +146,9 @@ class EtlExecutor:
 
         if task.output == T.SHUFFLE:
             if task.range_key is not None:
-                key, boundaries = task.range_key
-                buckets = T.range_buckets(table, key, boundaries)
+                key, boundaries, *rest = task.range_key
+                buckets = T.range_buckets(table, key, boundaries,
+                                          nulls_high=bool(rest and rest[0]))
             elif task.shuffle_keys:
                 buckets = T.hash_buckets(table, task.shuffle_keys, task.num_buckets)
             else:

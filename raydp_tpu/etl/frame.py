@@ -106,8 +106,17 @@ class DataFrame:
                           schema=self._schema)
 
     def sort(self, *cols_, ascending: bool = True) -> "DataFrame":
-        keys = [(c if isinstance(c, str) else c._name(),
-                 "ascending" if ascending else "descending") for c in cols_]
+        """Global sort. Columns are names, Column expressions, or
+        ``(name, "ascending"|"descending")`` tuples for per-key direction."""
+        keys = []
+        for c in cols_:
+            if isinstance(c, tuple):
+                name, order = c
+                keys.append((name if isinstance(name, str) else name._name(),
+                             order))
+            else:
+                keys.append((c if isinstance(c, str) else c._name(),
+                             "ascending" if ascending else "descending"))
         return self._with(P.Sort(self._plan, keys), schema=self._schema)
 
     orderBy = sort

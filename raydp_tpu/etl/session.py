@@ -63,7 +63,8 @@ class Session:
         for h in self.executors:
             h.wait_ready()
 
-        pool = ExecutorPool(self.executors)
+        pool = ExecutorPool(self.executors,
+                            hosts_by_name=self._executor_hosts())
         self.engine = Engine(
             pool,
             shuffle_partitions=self.config.get_int(cfg.SHUFFLE_PARTITIONS_KEY, 8),
@@ -98,6 +99,20 @@ class Session:
             block=block,
         )
 
+    def _executor_hosts(self) -> Dict[str, str]:
+        """Executor name → data-plane host id, for locality-aware scheduling
+        of ref-reading tasks (a no-op when everything shares one machine)."""
+        hosts: Dict[str, str] = {}
+        try:
+            rt = get_runtime()
+            for h in self.executors:
+                rec = rt.records.get(h.actor_id)
+                if rec is not None and h.name:
+                    hosts[h.name] = rt.store_host_of_node(rec.node_id)
+        except Exception:
+            pass
+        return hosts
+
     # ---- dynamic allocation -------------------------------------------------
     def request_total_executors(self, total: int) -> int:
         """Scale the executor gang to ``total`` live executors.
@@ -122,7 +137,8 @@ class Session:
             h.wait_ready()
         self.executors.extend(added)
         if self.engine is not None:
-            self.engine.pool = ExecutorPool(self.executors)
+            self.engine.pool = ExecutorPool(
+                self.executors, hosts_by_name=self._executor_hosts())
         logger.info("session %s scaled to %d executors", self.app_name,
                     len(self.executors))
         return len(self.executors)

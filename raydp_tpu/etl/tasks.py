@@ -312,8 +312,9 @@ class Task:
     shuffle_keys: Optional[List[str]] = None      # None → round-robin repartition
     # CACHE parameter
     cache_key: Optional[str] = None
-    # range-partition boundaries for sort (overrides hash bucketing)
-    range_key: Optional[Tuple[str, List]] = None
+    # range-partition spec for sort (overrides hash bucketing):
+    # (key, boundaries, nulls_high); legacy 2-tuples are tolerated
+    range_key: Optional[Tuple[str, List, bool]] = None
     owner: Optional[str] = None                   # object-store owner for outputs
 
     def with_output(self, **kw) -> "Task":
@@ -365,12 +366,18 @@ def round_robin_buckets(table: pa.Table, num_buckets: int,
     return [table.filter(pa.array(idx == b)) for b in range(num_buckets)]
 
 
-def range_buckets(table: pa.Table, key: str, boundaries: List) -> List[pa.Table]:
+def range_buckets(table: pa.Table, key: str, boundaries: List,
+                  nulls_high: bool = False) -> List[pa.Table]:
     """Partition rows by boundary values using Arrow comparisons — works for any
-    orderable type (ints, floats, strings, timestamps), no numeric cast."""
+    orderable type (ints, floats, strings, timestamps), no numeric cast.
+
+    ``nulls_high`` routes null keys to the LAST bucket instead of the first:
+    ``sort_by`` places nulls at_end within each bucket, so a globally correct
+    ascending sort needs them in the final bucket (descending sorts reverse
+    the bucket list, so there nulls stay in bucket 0 which becomes last)."""
     col_arr = table.column(key).combine_chunks()
     bucket = np.zeros(table.num_rows, dtype=np.int64)
     for b in boundaries:
-        gt = pc.fill_null(pc.greater(col_arr, pa.scalar(b)), False)
+        gt = pc.fill_null(pc.greater(col_arr, pa.scalar(b)), nulls_high)
         bucket += np.asarray(gt, dtype=np.int64)
     return [table.filter(pa.array(bucket == i)) for i in range(len(boundaries) + 1)]

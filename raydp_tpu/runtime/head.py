@@ -147,6 +147,13 @@ class HeadService:
     def store_store_payload(self, *a):
         return self._rt.store_server.store_payload(*a)
 
+    def store_locations(self, *a):
+        return self._rt.store_server.locations(*a)
+
+    def register_store_host(self, node_id: str, arena_segment):
+        """A node agent announces its machine-local payload plane."""
+        return self._rt.register_store_host(node_id, arena_segment)
+
     # ---- actor lifecycle ----------------------------------------------------
     def fetch_actor_spec(self, actor_id: str) -> Dict[str, Any]:
         rec = self._rt.record(actor_id)
@@ -166,26 +173,26 @@ class HeadService:
     def actor_ready(self, actor_id: str, host: str, port: int) -> None:
         self._rt.on_actor_ready(actor_id, (host, port))
 
-    def get_actor_address(self, actor_id: str) -> Optional[tuple]:
+    def get_actor_address(self, actor_id: str):
         rec = self._rt.records.get(actor_id)
         if rec is None or rec.state == DEAD:
             return None
-        if not rec.ready.is_set():
-            # brief grace for restarts in flight
-            rec.ready.wait(timeout=60.0)
-        return rec.address if rec.ready.is_set() else None
+        if rec.ready.is_set():
+            return rec.address
+        # restart in flight: wait WITHOUT parking this dispatcher thread —
+        # the reply completes when the actor reports ready (or 60s grace
+        # lapses), so a mass-restart flurry cannot starve unrelated traffic
+        return self._rt.add_ready_waiter(actor_id, 60.0, mode="address")
 
     def get_actor_state(self, actor_id: str) -> str:
         rec = self._rt.records.get(actor_id)
         return rec.state if rec else DEAD
 
-    def wait_actor_ready(self, actor_id: str, timeout: float) -> bool:
+    def wait_actor_ready(self, actor_id: str, timeout: float):
         rec = self._rt.record(actor_id)
-        if not rec.ready.wait(timeout=timeout):
-            raise TimeoutError(
-                f"actor {rec.spec.name or actor_id} not ready after {timeout}s "
-                f"(state={rec.state})")
-        return True
+        if rec.ready.is_set():
+            return True
+        return self._rt.add_ready_waiter(actor_id, timeout, mode="ready")
 
     def get_named_actor(self, name: str) -> Optional[str]:
         return self._rt.names.get(name)
@@ -224,11 +231,13 @@ class HeadService:
 
     def register_node_agent(self, host: str, port: int,
                             resources: Dict[str, float],
-                            address: str) -> Dict[str, Any]:
+                            address: str,
+                            store_isolated: bool = False) -> Dict[str, Any]:
         """A node agent joins: its machine becomes a schedulable node whose
         actor processes the head spawns through the agent (parity: a Ray
         raylet registering with the GCS, SURVEY.md §1 L1)."""
-        return self._rt.register_node_agent(host, port, resources, address)
+        return self._rt.register_node_agent(host, port, resources, address,
+                                            store_isolated)
 
     def create_placement_group(self, bundles: List[Dict[str, float]],
                                strategy: str) -> Dict[str, Any]:
@@ -301,7 +310,14 @@ class RuntimeContext:
         self.records: Dict[str, ActorRecord] = {}
         self.names: Dict[str, str] = {}
         self.node_agents: Dict[str, Any] = {}  # node_id → agent RpcClient
+        self.store_hosts: Dict[str, Optional[str]] = {}  # node_id → arena seg
+        # distributed data plane: payloads on agent machines are released /
+        # head-mediated-fetched through the owning node's agent RPC
+        self.store_server.node_release = self._node_store_release
+        self.store_server.node_fetch = self._node_store_fetch
         self._lock = threading.RLock()
+        self._waiters: List[tuple] = []  # (deadline, timeout, id, fut, mode)
+        self._waiters_lock = threading.Lock()
         self._stopped = threading.Event()
 
         self.service = HeadService(self)
@@ -427,11 +443,9 @@ class RuntimeContext:
             overrides[ENV_ACTOR_ID] = rec.spec.actor_id
             overrides[ENV_SESSION] = self.session_id
             overrides[ENV_SESSION_DIR] = self.session_dir
-            node = self.resource_manager.get_node(rec.node_id)
-            if node is not None and self.node_is_remote(node):
-                # a different machine cannot map this host's shared memory:
-                # its store client does payload IO over the table-server RPC
-                overrides["RDT_STORE_REMOTE"] = "1"
+            # data-plane env (RDT_STORE_HOST_ID / PAYLOAD_ADDR / ARENA) is
+            # injected by the agent itself at spawn: children on an isolated
+            # node write to and read from that machine's own payload plane
             # forward the driver's import path: cloudpickle pickles classes
             # by reference, so the child must resolve the driver's modules
             # (the agent appends its own path after these)
@@ -471,8 +485,59 @@ class RuntimeContext:
         rec.address = tuple(address)
         rec.state = ALIVE
         rec.ready.set()
+        self._resolve_waiters()
         logger.info("actor %s ready at %s (restart %d)",
                     rec.spec.name or actor_id, address, rec.restart_count)
+
+    # ---- non-blocking ready waits -------------------------------------------
+    def add_ready_waiter(self, actor_id: str, timeout: float, mode: str):
+        """A deferred reply completed by ``on_actor_ready`` / the supervisor
+        tick instead of a parked RPC thread. ``mode='address'`` resolves to
+        the address or None (get_actor_address contract); ``mode='ready'``
+        resolves to True or raises TimeoutError (wait_actor_ready)."""
+        from concurrent.futures import Future
+
+        from raydp_tpu.runtime.rpc import DeferredReply
+
+        fut: Future = Future()
+        with self._waiters_lock:
+            self._waiters.append(
+                (time.monotonic() + timeout, timeout, actor_id, fut, mode))
+        # the actor may have turned ready between the check and registration
+        self._resolve_waiters()
+        return DeferredReply(fut)
+
+    def _resolve_waiters(self) -> None:
+        now = time.monotonic()
+        with self._waiters_lock:
+            waiters, self._waiters = self._waiters, []
+        keep = []
+        for deadline, timeout, actor_id, fut, mode in waiters:
+            if fut.done():
+                continue
+            rec = self.records.get(actor_id)
+            if rec is not None and rec.ready.is_set() and rec.state == ALIVE:
+                fut.set_result(tuple(rec.address) if mode == "address"
+                               else True)
+            elif rec is None or rec.state == DEAD:
+                if mode == "address":
+                    fut.set_result(None)
+                else:
+                    fut.set_exception(TimeoutError(
+                        f"actor {actor_id} died while waiting "
+                        f"(state={rec.state if rec else 'unknown'})"))
+            elif now >= deadline:
+                if mode == "address":
+                    fut.set_result(None)
+                else:
+                    fut.set_exception(TimeoutError(
+                        f"actor {rec.spec.name or actor_id} not ready after "
+                        f"{timeout}s (state={rec.state})"))
+            else:
+                keep.append((deadline, timeout, actor_id, fut, mode))
+        if keep:
+            with self._waiters_lock:
+                self._waiters.extend(keep)
 
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
         with self._lock:
@@ -492,6 +557,7 @@ class RuntimeContext:
         while not self._stopped.is_set():
             try:
                 self._supervise_once()
+                self._resolve_waiters()
             except Exception:  # noqa: BLE001 - the supervisor must never die
                 logger.exception("supervisor tick failed; continuing")
             time.sleep(0.1)
@@ -597,17 +663,51 @@ class RuntimeContext:
 
     def register_node_agent(self, host: str, port: int,
                             resources: Dict[str, float],
-                            address: str) -> Dict[str, Any]:
+                            address: str,
+                            store_isolated: bool = False) -> Dict[str, Any]:
         from raydp_tpu.runtime.rpc import RpcClient
 
         client = RpcClient((host, int(port)))
         node_id = self.resource_manager.add_node(address, resources)
+        node = self.resource_manager.get_node(node_id)
+        # another machine cannot share this host's /dev/shm: its agent must
+        # host its own payload plane (tests force this with RDT_STORE_ISOLATED)
+        isolated = bool(store_isolated) or (node is not None
+                                            and self.node_is_remote(node))
         with self._lock:
             self.node_agents[node_id] = client
-        logger.info("node agent registered: %s at %s:%d (%s)",
-                    node_id, host, port, resources)
+        logger.info("node agent registered: %s at %s:%d (%s, store=%s)",
+                    node_id, host, port, resources,
+                    "isolated" if isolated else "shared")
         return {"node_id": node_id, "session_id": self.session_id,
-                "session_dir": self.session_dir}
+                "session_dir": self.session_dir,
+                "store_mode": "isolated" if isolated else "shared"}
+
+    def register_store_host(self, node_id: str,
+                            arena_segment: Optional[str]) -> bool:
+        with self._lock:
+            self.store_hosts[node_id] = arena_segment
+        return True
+
+    def store_host_of_node(self, node_id: Optional[str]) -> str:
+        """The data-plane host id for processes on ``node_id`` — the node id
+        itself when its agent hosts an isolated payload plane, else the head
+        machine's shared plane."""
+        if node_id is not None and node_id in self.store_hosts:
+            return node_id
+        return objstore.HEAD_HOST
+
+    def _node_store_release(self, host_id: str, items) -> None:
+        agent = self.node_agents.get(host_id)
+        if agent is not None:
+            agent.call("store_release", items, timeout=30.0)
+
+    def _node_store_fetch(self, host_id: str, segment: str, offset: int,
+                          size: int) -> bytes:
+        agent = self.node_agents.get(host_id)
+        if agent is None:
+            raise KeyError(f"node {host_id} is gone; payload unreadable")
+        return agent.call("store_fetch", segment, offset, size, timeout=60.0)
 
     def _agent_lost(self, node_id: str) -> None:
         agent = self.node_agents.pop(node_id, None)
@@ -620,9 +720,18 @@ class RuntimeContext:
         logger.warning("node agent for %s unreachable; removing node", node_id)
         self.remove_node(node_id)
 
+    def _purge_node_store(self, node_id: str) -> None:
+        """Node death: its payload plane is gone — drop its table entries so
+        readers fail into lineage recovery instead of timing out."""
+        with self._lock:
+            hosted = self.store_hosts.pop(node_id, "__absent__")
+        if hosted != "__absent__":
+            self.store_server.purge_host(node_id)
+
     def remove_node(self, node_id: str) -> None:
         """Fault injection: node death kills its actors; restartable actors are
         revived on surviving nodes (parity: test_spark_cluster.py:262-299)."""
+        self._purge_node_store(node_id)
         self.resource_manager.remove_node(node_id)
         with self._lock:
             victims = [rec for rec in self.records.values()
@@ -652,6 +761,11 @@ class RuntimeContext:
             if rec.process is not None:
                 _terminate(rec.process)
             rec.state = DEAD
+        self._resolve_waiters()  # every record is DEAD now: fail the waiters
+        self.store_client.close()
+        # store shutdown BEFORE agent teardown: node-hosted payload releases
+        # ride the still-open agent connections
+        self.store_server.shutdown()
         for node_id, agent in list(self.node_agents.items()):
             try:
                 agent.call("shutdown", timeout=5.0)
@@ -662,8 +776,6 @@ class RuntimeContext:
             except Exception:
                 pass
         self.node_agents.clear()
-        self.store_client.close()
-        self.store_server.shutdown()
         self.server.stop()
         objstore.set_client(None)
         logger.info("runtime head shut down (session %s)", self.session_id[:12])

@@ -10,10 +10,14 @@ machine instead of bookkeeping entries at 127.0.0.1. The head supervises the
 agent connection; an unreachable agent is node death — its actors are killed
 from the records and restartable ones revive on surviving nodes.
 
-Object-store note: actor processes attach the session's shared-memory segments
-directly, so agents on the *same* machine share the data plane zero-copy.
-Agents on other machines carry control-plane traffic over the same RPC; bulk
-payload reads from a remote store segment go through the head's table server.
+Object-store note: the agent is also its machine's payload plane in the
+distributed data plane. Agents on the head's machine share the head's
+shared-memory segments zero-copy; an agent on ANOTHER machine (or forced with
+``RDT_STORE_ISOLATED=1``) runs its own :class:`PayloadHost` — a node-local
+arena/segment namespace where its actors write payloads, served to readers on
+other machines with one direct RPC (never through the head). Parity: the
+per-node plasma store a raylet hosts for the reference
+(RayDPExecutor.scala:271-287 ``getBlockLocations``).
 """
 
 from __future__ import annotations
@@ -77,6 +81,25 @@ class NodeAgentService:
     def ping(self) -> str:
         return "pong"
 
+    # ---- node-local payload plane (isolated store mode) ---------------------
+    def store_fetch(self, segment: str, offset: int, size: int) -> bytes:
+        """Serve payload bytes hosted on this machine to a reader elsewhere —
+        the one-hop node-to-node transfer of the distributed data plane."""
+        return self._agent.payload_host.fetch(segment, offset, size)
+
+    def store_release(self, items) -> int:
+        return self._agent.payload_host.release(
+            [(seg, int(off)) for seg, off in items])
+
+    def store_reap(self) -> bool:
+        return self._agent.payload_host.reap()
+
+    def store_arena_info(self):
+        return self._agent.payload_host.arena_info()
+
+    def store_arena_stats(self):
+        return self._agent.payload_host.arena_stats()
+
 
 class NodeAgent:
     def __init__(self, head_url: str, resources: Dict[str, float],
@@ -92,16 +115,49 @@ class NodeAgent:
         self._lock = threading.Lock()
         self._stopped = threading.Event()
 
+        store_isolated = os.environ.get("RDT_STORE_ISOLATED") == "1"
         reply = self.head.call(
             "register_node_agent", self.server.address[0],
-            self.server.address[1], dict(resources), self.head.local_host)
+            self.server.address[1], dict(resources), self.head.local_host,
+            store_isolated)
         self.node_id = reply["node_id"]
         self.session_id = reply["session_id"]
         self.session_dir = reply["session_dir"]
         self.log_dir = log_dir or os.path.join(self.session_dir, "logs")
         os.makedirs(self.log_dir, exist_ok=True)
-        logger.info("node agent %s registered with %s (resources=%s)",
-                    self.node_id, head_url, resources)
+
+        # distributed data plane: on another machine (or when forced for
+        # tests) this agent hosts its own payload plane — node-local arena +
+        # segments, served over this agent's RPC
+        from raydp_tpu.runtime.object_store import PayloadHost
+        self.store_isolated = reply.get("store_mode") == "isolated"
+        self.payload_host = PayloadHost(
+            self._create_arena() if self.store_isolated else None)
+        if self.store_isolated:
+            info = self.payload_host.arena_info()
+            self.head.call("register_store_host", self.node_id,
+                           info["segment"] if info else None)
+        logger.info("node agent %s registered with %s (resources=%s, store=%s)",
+                    self.node_id, head_url, resources,
+                    "isolated" if self.store_isolated else "shared")
+
+    def _create_arena(self):
+        """Node-local arena for this machine's payloads; per-object segment
+        fallback when the native core is unavailable."""
+        try:
+            from raydp_tpu.native.arena import Arena
+            from raydp_tpu.runtime.head import _default_arena_size
+            size = int(os.environ.get("RDT_NODE_ARENA_SIZE",
+                                      _default_arena_size()))
+            arena = Arena.create(f"rdt{self.session_id[:8]}_n{os.getpid()}",
+                                 size)
+            logger.info("node-local store arena: %s (%d MiB)",
+                        arena.segment, size >> 20)
+            return arena
+        except Exception as e:
+            logger.warning("node arena unavailable (%s); per-object segments",
+                           e)
+            return None
 
     # ---- process management (driven by the head) ----------------------------
     def spawn(self, env_overrides: Dict[str, str], log_name: str,
@@ -117,6 +173,21 @@ class NodeAgent:
                 env.pop(k, None)
             else:
                 env[k] = v
+        if self.store_isolated:
+            # children write payloads into THIS machine's plane and read
+            # same-machine objects zero-copy; explicit overrides win
+            from raydp_tpu.runtime import object_store as objstore
+            info = self.payload_host.arena_info()
+            defaults = {
+                objstore.ENV_STORE_HOST_ID: self.node_id,
+                objstore.ENV_STORE_PAYLOAD_ADDR:
+                    f"{self.server.address[0]}:{self.server.address[1]}",
+            }
+            if info:
+                defaults[objstore.ENV_STORE_ARENA] = info["segment"]
+            for k, v in defaults.items():
+                if k not in env_overrides:
+                    env[k] = v
         # the child resolves driver-pickled classes by reference: the head's
         # forwarded PYTHONPATH (driver sys.path) takes precedence — matching
         # local-spawn semantics so one session never runs two code versions —
@@ -186,6 +257,10 @@ class NodeAgent:
                     except ProcessLookupError:
                         pass
         self.server.stop()
+        try:
+            self.payload_host.shutdown()
+        except Exception:
+            pass
         logger.info("node agent %s stopped", self.node_id)
 
 

@@ -52,6 +52,14 @@ KIND_ARROW = "arrow"
 
 DRIVER_OWNER = "__driver__"
 
+#: host id of the head's machine in the distributed data plane. Every other
+#: store host is keyed by the node id of the agent machine hosting it.
+HEAD_HOST = "head"
+
+ENV_STORE_HOST_ID = "RDT_STORE_HOST_ID"
+ENV_STORE_PAYLOAD_ADDR = "RDT_STORE_PAYLOAD_ADDR"
+ENV_STORE_ARENA = "RDT_STORE_ARENA"
+
 
 def _untrack(shm: shared_memory.SharedMemory) -> None:
     """Stop Python's resource tracker from unlinking the segment at process exit.
@@ -76,15 +84,21 @@ class _Entry:
     kind: str
     owner: str
     offset: int = -1  # >= 0: payload lives at this offset inside the arena
+    host_id: str = HEAD_HOST  # machine holding the payload
+    payload_addr: Optional[str] = None  # "host:port" serving cross-host fetches
     sealed: bool = True
 
 
-class ObjectStoreServer:
-    """Metadata server for the object table. Runs inside the head process.
+class PayloadHost:
+    """One machine's payload plane: local arena + per-object segments + frees.
 
-    All methods are called through the head's RPC server; they must stay cheap —
-    object payloads never pass through here, only segment names. When a native
-    arena is present the server also runs its free path (``rdt_free``).
+    This is the per-node plasma role in the distributed data plane: the head
+    runs one for its machine (inside :class:`ObjectStoreServer`), and every
+    node agent on another machine runs its own (``runtime/node_agent.py``), so
+    payload bytes are written and served where they live — readers on other
+    machines fetch them with ONE direct RPC to the owning node, never through
+    the head. Parity: per-node plasma stores + ``getBlockLocations`` routing
+    (reference RayDPExecutor.scala:271-287, RayDatasetRDD.scala:48-56).
     """
 
     #: seconds an arena-resident payload stays mapped after its free. Readers
@@ -96,14 +110,11 @@ class ObjectStoreServer:
     #: contents), so arena mode defers reclamation for a grace period instead.
     ARENA_FREE_GRACE_S = float(os.environ.get("RDT_ARENA_FREE_GRACE_S", "60"))
 
-    def __init__(self, session_id: str, arena=None):
-        self.session_id = session_id
+    def __init__(self, arena=None):
         self._arena = arena
         # rdt_free/munmap on the arena base must not interleave: a supervisor
         # or RPC thread freeing a dead owner's blocks races session shutdown.
         self._arena_lock = threading.Lock()
-        self._lock = threading.Lock()
-        self._table: Dict[str, _Entry] = {}
         self._deferred: List[Tuple[float, int]] = []  # (due time, offset)
 
     # -- arena ----------------------------------------------------------------
@@ -116,49 +127,33 @@ class ObjectStoreServer:
         with self._arena_lock:
             return None if self._arena is None else self._arena.stats()
 
-    def arena_reap(self) -> bool:
+    def reap(self) -> bool:
         """Free deferred allocations whose grace elapsed (writers call this
         when the arena looks full before falling back to segments)."""
         self._reap_deferred()
         return True
 
-    # -- write path -----------------------------------------------------------
-    def seal(self, object_id: str, segment: str, size: int, kind: str,
-             owner: str, offset: int = -1) -> None:
-        with self._lock:
-            if object_id in self._table:
-                raise KeyError(f"object {object_id} already sealed")
-            self._table[object_id] = _Entry(segment, size, kind, owner, offset)
-        self._reap_deferred()
-
-    # -- remote payload path (readers/writers on OTHER machines) --------------
-    def fetch_payload(self, object_id: str) -> Tuple[bytes, str]:
-        """Payload bytes + kind, for clients that cannot map this host's
-        shared memory (actors on node-agent machines). The zero-copy fast
-        path stays same-host; cross-host transfers ride the control RPC —
-        the role Ray's object transfer service plays for the reference."""
-        segment, size, kind, offset = self.lookup(object_id)
+    # -- payload IO ------------------------------------------------------------
+    def fetch(self, segment: str, offset: int, size: int) -> bytes:
+        """Payload bytes for a reader on ANOTHER machine (one direct hop)."""
         if offset >= 0:
             with self._arena_lock:
-                if self._arena is None:
-                    raise KeyError(f"arena gone; object {object_id} unreadable")
-                return bytes(self._arena.view(offset, size)), kind
+                if self._arena is None or segment != self._arena.segment:
+                    raise KeyError(f"arena segment {segment} not hosted here")
+                return bytes(self._arena.view(offset, size))
         shm = shared_memory.SharedMemory(name=segment)
         try:
             _untrack(shm)
-            return bytes(shm.buf[:size]), kind
+            return bytes(shm.buf[:size])
         finally:
             shm.close()
 
-    def store_payload(self, object_id: str, data: bytes, kind: str,
-                      owner: str) -> int:
-        """Write + seal on behalf of a remote client; returns the size."""
+    def write(self, data: bytes, segment_name: str) -> Tuple[str, int]:
+        """Write bytes locally (arena first, dedicated segment fallback);
+        returns ``(segment, offset)`` with ``offset=-1`` for a segment."""
         size = len(data)
-        offset = None
-        segment = None
         with self._arena_lock:
             if self._arena is not None:
-                segment = self._arena.segment
                 offset = self._arena.alloc(size)
                 if offset is not None:
                     try:
@@ -167,17 +162,8 @@ class ObjectStoreServer:
                     except BaseException:
                         self._arena.free(offset)
                         raise
-        if offset is not None:
-            try:
-                self.seal(object_id, segment, size, kind, owner, offset)
-            except BaseException:
-                with self._arena_lock:
-                    if self._arena is not None:
-                        self._arena.free(offset)
-                raise
-            return size
-        seg = f"rdt{self.session_id[:8]}_{object_id}"
-        shm = shared_memory.SharedMemory(name=seg, create=True,
+                    return self._arena.segment, offset
+        shm = shared_memory.SharedMemory(name=segment_name, create=True,
                                          size=max(size, 1))
         try:
             if size:
@@ -185,48 +171,25 @@ class ObjectStoreServer:
         finally:
             _untrack(shm)
             shm.close()
-        self.seal(object_id, seg, size, kind, owner)
-        return size
+        return segment_name, -1
 
-    # -- read path ------------------------------------------------------------
-    def lookup(self, object_id: str) -> Tuple[str, int, str, int]:
-        with self._lock:
-            e = self._table.get(object_id)
-            if e is None:
-                raise KeyError(f"object {object_id} not found")
-            return e.segment, e.size, e.kind, e.offset
-
-    def contains(self, object_id: str) -> bool:
-        with self._lock:
-            return object_id in self._table
-
-    # -- lifetime: ownership-based (owner death sweeps; explicit free releases).
-    # A refcount protocol is deliberately absent — every object has exactly one
-    # owner and lineage makes re-creation cheap, so ownership is the whole story.
-    def free(self, object_ids: List[str]) -> int:
-        """Explicitly delete objects regardless of owner (release path,
-        parity with ``release_spark_recoverable``, dataset.py:224-237)."""
-        freed = []
-        with self._lock:
-            for oid in object_ids:
-                e = self._table.pop(oid, None)
-                if e is not None:
-                    freed.append(e)
-        for e in freed:
-            self._release_payload(e)
-        return len(freed)
-
-    def _release_payload(self, e: _Entry) -> None:
-        if e.offset >= 0:
-            import time as _time
-            with self._arena_lock:
-                if self._arena is not None:
-                    self._deferred.append(
-                        (_time.monotonic() + self.ARENA_FREE_GRACE_S,
-                         e.offset))
-            self._reap_deferred()
-        else:
-            _unlink_segment(e.segment)
+    # -- release ---------------------------------------------------------------
+    def release(self, items: List[Tuple[str, int]]) -> int:
+        """Release payloads: ``(segment, offset)`` pairs. Arena offsets are
+        deferred for the view-grace period; dedicated segments unlink now."""
+        import time as _time
+        due = _time.monotonic() + self.ARENA_FREE_GRACE_S
+        n = 0
+        for segment, offset in items:
+            if offset >= 0:
+                with self._arena_lock:
+                    if self._arena is not None:
+                        self._deferred.append((due, int(offset)))
+            else:
+                _unlink_segment(segment)
+            n += 1
+        self._reap_deferred()
+        return n
 
     def _reap_deferred(self, everything: bool = False) -> None:
         """Free arena offsets whose grace period elapsed (activity-driven:
@@ -245,6 +208,139 @@ class ObjectStoreServer:
                     keep.append((due, offset))
             self._deferred = keep
 
+    def shutdown(self) -> None:
+        self._reap_deferred(everything=True)
+        with self._arena_lock:
+            if self._arena is not None:
+                self._arena.close()
+                self._arena = None
+
+
+class ObjectStoreServer:
+    """Metadata server for the object table. Runs inside the head process.
+
+    All methods are called through the head's RPC server; they must stay cheap —
+    object payloads never pass through here, only segment names. The head's
+    machine-local payload plane (arena + segments) is an embedded
+    :class:`PayloadHost`; payloads on agent machines are released/fetched
+    through the ``node_release`` / ``node_fetch`` callbacks the runtime wires
+    to the owning node's agent RPC.
+    """
+
+    def __init__(self, session_id: str, arena=None):
+        self.session_id = session_id
+        self.host = PayloadHost(arena)
+        self._lock = threading.Lock()
+        self._table: Dict[str, _Entry] = {}
+        #: head-mediated payload RPC counters — the distributed-plane tests
+        #: assert these stay flat while cross-node traffic flows node→node
+        self.payload_rpc_count = 0
+        # callbacks wired by RuntimeContext for payloads on agent machines
+        self.node_release = None  # (host_id, [(segment, offset)]) -> None
+        self.node_fetch = None    # (host_id, segment, offset, size) -> bytes
+
+    # -- arena (head machine) --------------------------------------------------
+    def arena_info(self) -> Optional[Dict[str, Any]]:
+        return self.host.arena_info()
+
+    def arena_stats(self) -> Optional[Dict[str, int]]:
+        return self.host.arena_stats()
+
+    def arena_reap(self) -> bool:
+        return self.host.reap()
+
+    # -- write path -----------------------------------------------------------
+    def seal(self, object_id: str, segment: str, size: int, kind: str,
+             owner: str, offset: int = -1, host_id: str = HEAD_HOST,
+             payload_addr: Optional[str] = None) -> None:
+        with self._lock:
+            if object_id in self._table:
+                raise KeyError(f"object {object_id} already sealed")
+            self._table[object_id] = _Entry(segment, size, kind, owner, offset,
+                                            host_id, payload_addr)
+        self.host.reap()
+
+    # -- head-mediated payload path (clients with NO shared memory at all) -----
+    def fetch_payload(self, object_id: str) -> Tuple[bytes, str]:
+        """Payload bytes + kind through the head — the slow compatibility path
+        for shm-less clients. Machine-local readers attach segments directly;
+        cross-machine readers go straight to the owning node's PayloadHost."""
+        segment, size, kind, offset, host_id, _ = self.lookup(object_id)
+        self.payload_rpc_count += 1
+        if host_id != HEAD_HOST:
+            if self.node_fetch is None:
+                raise KeyError(f"object {object_id} lives on {host_id}; "
+                               "no node fetch route")
+            return self.node_fetch(host_id, segment, offset, size), kind
+        return self.host.fetch(segment, offset, size), kind
+
+    def store_payload(self, object_id: str, data: bytes, kind: str,
+                      owner: str) -> int:
+        """Write + seal on behalf of a shm-less client; returns the size."""
+        self.payload_rpc_count += 1
+        seg_name = f"rdt{self.session_id[:8]}_{object_id}"
+        segment, offset = self.host.write(data, seg_name)
+        try:
+            self.seal(object_id, segment, len(data), kind, owner, offset)
+        except BaseException:
+            self.host.release([(segment, offset)])
+            raise
+        return len(data)
+
+    # -- read path ------------------------------------------------------------
+    def lookup(self, object_id: str
+               ) -> Tuple[str, int, str, int, str, Optional[str]]:
+        with self._lock:
+            e = self._table.get(object_id)
+            if e is None:
+                raise KeyError(f"object {object_id} not found")
+            return (e.segment, e.size, e.kind, e.offset, e.host_id,
+                    e.payload_addr)
+
+    def contains(self, object_id: str) -> bool:
+        with self._lock:
+            return object_id in self._table
+
+    def locations(self, object_ids: List[str]) -> Dict[str, str]:
+        """``object_id -> host_id`` for the ids present — the engine's
+        locality source (parity: ``getBlockLocations`` / preferred locations,
+        RayDPExecutor.scala:271-287, RayDatasetRDD.scala:48-56)."""
+        with self._lock:
+            return {oid: self._table[oid].host_id for oid in object_ids
+                    if oid in self._table}
+
+    # -- lifetime: ownership-based (owner death sweeps; explicit free releases).
+    # A refcount protocol is deliberately absent — every object has exactly one
+    # owner and lineage makes re-creation cheap, so ownership is the whole story.
+    def free(self, object_ids: List[str]) -> int:
+        """Explicitly delete objects regardless of owner (release path,
+        parity with ``release_spark_recoverable``, dataset.py:224-237)."""
+        freed = []
+        with self._lock:
+            for oid in object_ids:
+                e = self._table.pop(oid, None)
+                if e is not None:
+                    freed.append(e)
+        self._release_payloads(freed)
+        return len(freed)
+
+    def _release_payloads(self, entries: List[_Entry]) -> None:
+        local = [(e.segment, e.offset) for e in entries
+                 if e.host_id == HEAD_HOST]
+        if local:
+            self.host.release(local)
+        by_node: Dict[str, List[Tuple[str, int]]] = {}
+        for e in entries:
+            if e.host_id != HEAD_HOST:
+                by_node.setdefault(e.host_id, []).append((e.segment, e.offset))
+        for host_id, items in by_node.items():
+            if self.node_release is None:
+                continue
+            try:
+                self.node_release(host_id, items)
+            except Exception as exc:  # node may be dead; lineage re-creates
+                logger.warning("release on node %s failed: %s", host_id, exc)
+
     def transfer_ownership(self, object_ids: List[str], new_owner: str) -> int:
         with self._lock:
             n = 0
@@ -261,9 +357,22 @@ class ObjectStoreServer:
         with self._lock:
             for oid in [o for o, e in self._table.items() if e.owner == owner]:
                 freed.append(self._table.pop(oid))
-        for e in freed:
-            self._release_payload(e)
+        self._release_payloads(freed)
         return len(freed)
+
+    def purge_host(self, host_id: str) -> int:
+        """Node death: its payloads are gone — drop their table entries so
+        readers fail fast into lineage recovery instead of timing out."""
+        dropped = 0
+        with self._lock:
+            for oid in [o for o, e in self._table.items()
+                        if e.host_id == host_id]:
+                del self._table[oid]
+                dropped += 1
+        if dropped:
+            logger.warning("purged %d objects hosted on dead node %s",
+                           dropped, host_id)
+        return dropped
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -271,6 +380,7 @@ class ObjectStoreServer:
                 "num_objects": len(self._table),
                 "total_bytes": sum(e.size for e in self._table.values()),
                 "owners": sorted({e.owner for e in self._table.values()}),
+                "hosts": sorted({e.host_id for e in self._table.values()}),
             }
 
     def owned_by(self, owner: str) -> List[str]:
@@ -281,14 +391,14 @@ class ObjectStoreServer:
         with self._lock:
             entries = list(self._table.values())
             self._table.clear()
+        # node-hosted payloads: route their release to the owning agents
+        # BEFORE the runtime tears the agents down (dedicated /dev/shm
+        # segments on a node would otherwise outlive the session)
+        self._release_payloads([e for e in entries if e.host_id != HEAD_HOST])
         for e in entries:
-            if e.offset < 0:
+            if e.host_id == HEAD_HOST and e.offset < 0:
                 _unlink_segment(e.segment)
-        self._reap_deferred(everything=True)
-        with self._arena_lock:
-            if self._arena is not None:
-                self._arena.close()
-                self._arena = None
+        self.host.shutdown()
 
 
 def _unlink_segment(segment: str) -> None:
@@ -331,7 +441,8 @@ class ObjectStoreClient:
     """
 
     def __init__(self, server, session_id: str, default_owner: str = DRIVER_OWNER,
-                 remote: Optional[bool] = None):
+                 remote: Optional[bool] = None, host_id: Optional[str] = None,
+                 payload_addr: Optional[str] = None):
         self._server = server
         self.session_id = session_id
         self.default_owner = default_owner
@@ -339,9 +450,17 @@ class ObjectStoreClient:
         self._lock = threading.Lock()
         self._arena = None          # native write handle, lazily probed
         self._arena_probed = False
-        # remote mode: this process cannot map the head's shared memory (it
-        # runs on another machine, spawned by a node agent there); all
-        # payload IO goes through the table server's fetch/store RPCs
+        # distributed data plane: which machine this process is on, and the
+        # address of that machine's payload server (node agent RPC; None =
+        # the head). Writes land in the machine-local arena/segments; reads
+        # of objects on OTHER machines go directly to the owning node.
+        self.host_id = (host_id if host_id is not None
+                        else os.environ.get(ENV_STORE_HOST_ID, HEAD_HOST))
+        self.payload_addr = (payload_addr if payload_addr is not None
+                             else os.environ.get(ENV_STORE_PAYLOAD_ADDR))
+        self._peers: Dict[str, Any] = {}  # payload_addr -> RpcClient
+        # remote mode: this process has no usable shared memory at all; every
+        # payload read and write is head-mediated (compatibility slow path)
         self.remote = (os.environ.get("RDT_STORE_REMOTE") == "1"
                        if remote is None else bool(remote))
 
@@ -350,23 +469,59 @@ class ObjectStoreClient:
         return f"rdt{self.session_id[:8]}_{object_id}"
 
     def _write_arena(self):
-        """The native arena handle for allocations, or None (fallback mode)."""
+        """The machine-local arena handle for allocations, or None (fallback).
+
+        Head-machine processes attach the head's arena; processes on an
+        isolated node attach the node's own arena (segment name handed down
+        via ``RDT_STORE_ARENA`` by the node agent that spawned them)."""
         if self._arena_probed:
             return self._arena
         with self._lock:
             if self._arena_probed:
                 return self._arena
             try:
-                info = self._server.arena_info()
-                if info is not None:
-                    from raydp_tpu.native.arena import Arena
-                    self._arena = Arena.attach(info["segment"])
+                if self.host_id != HEAD_HOST:
+                    segment = os.environ.get(ENV_STORE_ARENA)
+                    if segment:
+                        from raydp_tpu.native.arena import Arena
+                        self._arena = Arena.attach(segment)
+                else:
+                    info = self._server.arena_info()
+                    if info is not None:
+                        from raydp_tpu.native.arena import Arena
+                        self._arena = Arena.attach(info["segment"])
             except Exception as e:
                 logger.warning("arena attach failed (%s); using per-object "
                                "segments in this process", e)
                 self._arena = None
             self._arena_probed = True
         return self._arena
+
+    def _peer(self, addr: str):
+        """RPC client to another machine's payload server (node agent).
+        Connects OUTSIDE the client-wide lock (a dead node's connect timeout
+        must not stall unrelated same-host reads/writes in this process)."""
+        with self._lock:
+            client = self._peers.get(addr)
+        if client is not None and not client._closed:  # noqa: SLF001
+            return client
+        from raydp_tpu.runtime.rpc import RpcClient
+        host, port = addr.rsplit(":", 1)
+        fresh = RpcClient((host, int(port)), connect_timeout=5.0)
+        with self._lock:
+            cur = self._peers.get(addr)
+            if cur is not None and not cur._closed:  # noqa: SLF001
+                fresh.close()
+                return cur
+            self._peers[addr] = fresh
+            return fresh
+
+    def _local_reap(self) -> None:
+        """Ask this machine's payload host to reap expired deferred frees."""
+        if self.host_id == HEAD_HOST:
+            self._server.arena_reap()
+        elif self.payload_addr:
+            self._peer(self.payload_addr).call("store_reap", timeout=30.0)
 
     # -- write ----------------------------------------------------------------
     def put_raw(self, data, kind: str = KIND_RAW, owner: Optional[str] = None) -> ObjectRef:
@@ -383,9 +538,10 @@ class ObjectStoreClient:
             offset = arena.alloc(size)
             if offset is None:
                 # expired deferred frees may be holding the space: reap on
-                # the server and retry once before the slow per-segment path
+                # this machine's payload host and retry once before the slow
+                # per-segment path
                 try:
-                    self._server.arena_reap()
+                    self._local_reap()
                     offset = arena.alloc(size)
                 except Exception:
                     offset = None
@@ -398,7 +554,8 @@ class ObjectStoreClient:
                         else:
                             view[:] = data
                     self._server.seal(object_id, arena.segment, size, kind,
-                                      owner or self.default_owner, offset)
+                                      owner or self.default_owner, offset,
+                                      self.host_id, self.payload_addr)
                 except BaseException:
                     # unsealed allocation would leak until session end
                     try:
@@ -420,7 +577,9 @@ class ObjectStoreClient:
                 shm.buf[:size] = data
         _untrack(shm)
         shm.close()
-        self._server.seal(object_id, seg_name, size, kind, owner or self.default_owner)
+        self._server.seal(object_id, seg_name, size, kind,
+                          owner or self.default_owner, -1,
+                          self.host_id, self.payload_addr)
         return ObjectRef(id=object_id, size=size, kind=kind)
 
     def put(self, obj: Any, owner: Optional[str] = None) -> ObjectRef:
@@ -440,7 +599,20 @@ class ObjectStoreClient:
         if self.remote:
             data, kind = self._server.fetch_payload(object_id)
             return memoryview(data), kind
-        segment, size, kind, offset = self._server.lookup(object_id)
+        segment, size, kind, offset, host_id, payload_addr = \
+            self._server.lookup(object_id)
+        if host_id != self.host_id:
+            # payload lives on another machine: ONE direct hop to the owning
+            # node's payload server (never through the head — parity with
+            # plasma's node-to-node object transfer)
+            if payload_addr:
+                # bounded: a wedged-but-connected owner must fail the read
+                # into task retry / lineage recovery, not hang it
+                data = self._peer(payload_addr).call(
+                    "store_fetch", segment, offset, size, timeout=60.0)
+            else:  # owner is the head machine; the table server serves it
+                data, kind = self._server.fetch_payload(object_id)
+            return memoryview(data), kind
         with self._lock:
             shm = self._attached.get(segment)
             if shm is None:
@@ -484,6 +656,10 @@ class ObjectStoreClient:
     def contains(self, ref: ObjectRef) -> bool:
         return self._server.contains(ref.id)
 
+    def locations(self, refs: List[ObjectRef]) -> Dict[str, str]:
+        """``object_id -> host_id`` (the machine holding each payload)."""
+        return self._server.locations([r.id for r in refs])
+
     def stats(self) -> Dict[str, Any]:
         return self._server.stats()
 
@@ -506,6 +682,12 @@ class ObjectStoreClient:
                 except Exception:
                     pass
             self._attached.clear()
+            for client in self._peers.values():
+                try:
+                    client.close()
+                except Exception:
+                    pass
+            self._peers.clear()
             # the write-arena mapping is deliberately NOT munmapped here: an
             # in-flight put_raw may still be writing through a view, and the
             # OS reclaims the mapping at process exit anyway

@@ -269,9 +269,9 @@ class SPMDJob:
         agent, node = self._rank_agent(rank)
         if agent is not None:
             # None-valued overrides ride through: the agent applies them as
-            # removals in the child env (NodeAgent.spawn)
-            if rt is not None and node is not None and rt.node_is_remote(node):
-                env_overrides["RDT_STORE_REMOTE"] = "1"
+            # removals in the child env (NodeAgent.spawn). Data-plane env
+            # (RDT_STORE_HOST_ID / PAYLOAD_ADDR / ARENA) is injected by the
+            # agent itself when its machine hosts an isolated payload plane.
             pid = agent.call("spawn", env_overrides,
                              f"spmd-{self.job_name}-rank{rank}",
                              ["-u", "-m", "raydp_tpu.spmd.worker"],

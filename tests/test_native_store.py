@@ -144,7 +144,7 @@ def test_store_uses_arena(runtime):
 
     table = pa.table({"a": np.arange(1000), "b": np.random.rand(1000)})
     ref = client.put(table)
-    seg, size, kind, offset = runtime.store_server.lookup(ref.id)
+    seg, size, kind, offset, host_id, _ = runtime.store_server.lookup(ref.id)
     assert offset >= 0 and seg == info["segment"]
     got = client.get(ref)
     assert got.equals(table)
@@ -157,7 +157,7 @@ def test_store_uses_arena(runtime):
     # (device feed, lineage recovery) can't be overwritten under the reader
     assert runtime.store_server.arena_stats()["bytes_in_use"] == before
     assert view_table.equals(table)
-    runtime.store_server._reap_deferred(everything=True)
+    runtime.store_server.host._reap_deferred(everything=True)
     after = runtime.store_server.arena_stats()["bytes_in_use"]
     assert after < before
 
@@ -172,7 +172,7 @@ def test_store_survives_actor_writes(runtime):
 
     handle = runtime.create_actor(Writer, name="arena-writer")
     ref = handle.call("put_table", 4096)
-    seg, size, kind, offset = runtime.store_server.lookup(ref.id)
+    seg, size, kind, offset, host_id, _ = runtime.store_server.lookup(ref.id)
     assert offset >= 0, "actor write did not use the arena"
     table = runtime.store_client.get(ref)
     assert table.num_rows == 4096
@@ -190,7 +190,7 @@ def test_store_native_off(monkeypatch):
         assert rt.store_server.arena_info() is None
         ref = rt.store_client.put({"k": 1})
         assert rt.store_client.get(ref) == {"k": 1}
-        seg, size, kind, offset = rt.store_server.lookup(ref.id)
+        seg, size, kind, offset, host_id, _ = rt.store_server.lookup(ref.id)
         assert offset == -1
     finally:
         rt.shutdown()
