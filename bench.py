@@ -27,6 +27,7 @@ import os
 import sys
 import tempfile
 import time
+from functools import partial
 from typing import Optional
 
 # Self-measured reference numbers (benchmarks/reference_nyctaxi_torch.py,
@@ -210,14 +211,65 @@ def bench_keras() -> dict:
         raydp_tpu.stop()
 
 
+# ----------------------------------------------------------------------- gbdt
+def bench_gbdt() -> dict:
+    """GBDT training on the NYCTaxi shape (BASELINE workload
+    examples/xgboost_ray_nyctaxi.py:60-75: hist trees, 90/10 split,
+    fare_amount label, num_boost_round=10, per-round eval). Throughput =
+    training rows × boosting rounds / fit wall — each round is one full
+    histogram pass over every row, the hist-method unit of work."""
+    import raydp_tpu
+    from generate_nyctaxi import generate
+    from nyctaxi_features import LABEL, feature_columns, nyc_taxi_preprocess
+    from raydp_tpu.train import GBDTEstimator
+    from raydp_tpu.utils import random_split
+
+    rows = min(ROWS, 200_000)
+    rounds = int(os.environ.get("BENCH_GBDT_ROUNDS", "10"))
+    tmp = tempfile.mkdtemp(prefix="rdt-bench-")
+    csv_path = os.path.join(tmp, "nyctaxi.csv")
+    generate(rows).to_csv(csv_path, index=False)
+    session = raydp_tpu.init("bench-gbdt", num_executors=2, executor_cores=2,
+                             executor_memory="2GB")
+    try:
+        data = session.read.csv(csv_path, num_partitions=4)
+        data = nyc_taxi_preprocess(data)
+        features = feature_columns(data)
+        train_df, test_df = random_split(data, [0.9, 0.1], 0)
+        est = GBDTEstimator(
+            params={"tree_method": "hist", "max_depth": 6},
+            feature_columns=features, label_column=LABEL,
+            num_boost_round=rounds)
+        t_etl = time.perf_counter()
+        train_ds, eval_ds = est._convert_frames(train_df, test_df)
+        t0 = time.perf_counter()
+        result = est.fit(train_ds, eval_ds)
+        wall = time.perf_counter() - t0
+        n_train = int(rows * 0.9)
+        report = result.history[-1]
+        return {"samples_per_s_per_chip":
+                round(n_train * rounds / wall / _num_chips(), 1),
+                "throughput_def": "train_rows*rounds/fit_wall",
+                "rows": rows, "rounds": rounds,
+                "train_rmse": report.get("train_rmse"),
+                "eval_rmse": report.get("eval_rmse"),
+                "fit_wall_s": round(wall, 1),
+                "wall_s": round(time.perf_counter() - t_etl, 1)}
+    finally:
+        raydp_tpu.stop()
+
+
 # ----------------------------------------------------------------------- gang
 def bench_gang() -> dict:
     """Multi-worker data-parallel gang (BASELINE.json configs: "NYCTaxi MLP
     via raytrain_nyctaxi.py (Ray Train data-parallel, 8 workers)" and the
-    Horovod-allreduce→psum port): 2 rank processes × 4 virtual CPU devices
-    under one ``jax.distributed`` mesh. Ranks are pinned to CPU — two
-    processes cannot share the one physical TPU chip — so this config records
-    the gang-orchestration path honestly (labeled cpu-gang), not chip speed.
+    Horovod-allreduce→psum port), swept at 1/2/4 rank processes over a FIXED
+    8-virtual-CPU-device global mesh (8/4/2 devices per rank): same global
+    batch and model at every width, so the curve isolates gang-orchestration
+    cost — process fan-out, per-rank host feed, cross-process collectives —
+    from compute. Ranks are pinned to CPU (two processes cannot share the one
+    physical TPU chip), labeled cpu-gang; ``scaling`` is throughput relative
+    to the 1-worker gang.
     """
     import optax
 
@@ -232,37 +284,52 @@ def bench_gang() -> dict:
     tmp = tempfile.mkdtemp(prefix="rdt-bench-")
     csv_path = os.path.join(tmp, "nyctaxi.csv")
     generate(rows).to_csv(csv_path, index=False)
-    # 1-core executors: the gang's 2 rank bundles must also fit on this node
+    # a wide virtual node: the widest gang's 4 rank bundles must fit beside
+    # the 2 executors regardless of the host's advertised core count
     session = raydp_tpu.init("bench-gang", num_executors=2, executor_cores=1,
-                             executor_memory="2GB")
+                             executor_memory="2GB",
+                             virtual_nodes=[{"CPU": 16.0,
+                                             "memory": float(8 << 30)}])
     try:
         data = session.read.csv(csv_path, num_partitions=4)
         data = nyc_taxi_preprocess(data)
         features = feature_columns(data)
-        est = FlaxEstimator(
-            model=NYCTaxiModel(),
-            optimizer=optax.adam(1e-3),
-            loss="smooth_l1",
-            feature_columns=features,
-            label_column=LABEL,
-            batch_size=min(BATCH, 4096),
-            num_epochs=3,
-            shuffle=False,
-        )
         ds = from_frame_recoverable(data)
-        t0 = time.perf_counter()
-        result = est.fit_gang(
-            ds, num_workers=2, run_timeout=1800.0,
-            worker_env={
-                "JAX_PLATFORMS": "cpu",
-                "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-                "PALLAS_AXON_POOL_IPS": None,  # keep ranks off the TPU tunnel
-            })
-        wall = time.perf_counter() - t0
-        return {"samples_per_s_gang": _steady(result.history),
-                "workers": 2, "devices": 8, "platform": "cpu-gang",
+
+        sweep = {}
+        for workers in (1, 2, 4):
+            est = FlaxEstimator(
+                model=NYCTaxiModel(),
+                optimizer=optax.adam(1e-3),
+                loss="smooth_l1",
+                feature_columns=features,
+                label_column=LABEL,
+                batch_size=min(BATCH, 4096),
+                num_epochs=3,
+                shuffle=False,
+            )
+            t0 = time.perf_counter()
+            result = est.fit_gang(
+                ds, num_workers=workers, run_timeout=1800.0,
+                worker_env={
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count="
+                                 f"{8 // workers}",
+                    # keep ranks off the TPU tunnel
+                    "PALLAS_AXON_POOL_IPS": None,
+                })
+            sweep[workers] = {
+                "samples_per_s": round(_steady(result.history), 1),
                 "final_loss": result.history[-1].get("train_loss"),
-                "wall_s": round(wall, 1), "rows": rows}
+                "wall_s": round(time.perf_counter() - t0, 1),
+            }
+        base = sweep[1]["samples_per_s"] or 1.0
+        out = {"samples_per_s_gang": sweep[2]["samples_per_s"],
+               "devices": 8, "platform": "cpu-gang", "rows": rows,
+               "sweep": {str(w): v for w, v in sweep.items()},
+               "scaling": {str(w): round(v["samples_per_s"] / base, 3)
+                           for w, v in sweep.items()}}
+        return out
     finally:
         raydp_tpu.stop()
 
@@ -289,6 +356,7 @@ def _lm_mode_run(mode: str, T: int) -> dict:
     import optax
 
     from raydp_tpu.models import TransformerLM, lm_loss
+    from raydp_tpu.models.transformer import lm_loss_fused
 
     dim = int(os.environ.get("BENCH_LM_DIM", "512"))
     if dim % 64:
@@ -316,13 +384,25 @@ def _lm_mode_run(mode: str, T: int) -> dict:
     # tok/s ≈ 40x peak FLOPs).
     from jax import lax
 
-    @jax.jit
+    # BENCH_LM_FUSED: 0 = materialized [B,T,V] f32 logits, 1 = chunked fused
+    # CE with remat (smallest memory), 2 = chunked fused CE without remat
+    # (bf16 chunk logits stored; no head recompute). Measured on v5e at
+    # dim=512/T=8192 the three are within ~10% — see bench notes.
+    fused = os.environ.get("BENCH_LM_FUSED", "0")
+
+    def step_loss(p, tokens):
+        if fused in ("1", "2"):
+            hidden = model.apply({"params": p}, tokens, return_hidden=True)
+            return lm_loss_fused(hidden, p["lm_head"]["kernel"], tokens,
+                                 remat=fused == "1")
+        return lm_loss(model.apply({"params": p}, tokens), tokens)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
     def run_steps(params, opt, tokens):
         def body(carry, _):
             params, opt = carry
             loss, grads = jax.value_and_grad(
-                lambda p: lm_loss(model.apply({"params": p}, tokens), tokens)
-            )(params)
+                lambda p: step_loss(p, tokens))(params)
             upd, opt = tx.update(grads, opt, params)
             return (optax.apply_updates(params, upd), opt), loss
 
@@ -409,11 +489,12 @@ def main():
               file=sys.stderr)
 
     selected = [c.strip() for c in os.environ.get(
-        "BENCH_CONFIGS", "nyctaxi,dlrm,keras,transformer,gang").split(",")
+        "BENCH_CONFIGS",
+        "nyctaxi,dlrm,keras,transformer,gbdt,gang").split(",")
         if c.strip()]
     table = {"nyctaxi": bench_nyctaxi, "dlrm": bench_dlrm,
              "keras": bench_keras, "transformer": bench_transformer,
-             "gang": bench_gang}
+             "gbdt": bench_gbdt, "gang": bench_gang}
     extra = {}
     primary = None
     for name in selected:

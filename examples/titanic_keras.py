@@ -1,0 +1,165 @@
+"""Titanic-style binary classification on the Keras estimator path.
+
+Parity: the reference's classification on-ramp
+(``/root/reference/examples/tensorflow_titanic.ipynb``): load a Titanic-shaped
+passenger table, clean and encode it with the distributed ETL engine, then
+train a Keras classifier through :class:`raydp_tpu.train.KerasEstimator`
+(binary cross-entropy + accuracy), exactly the estimator flow the notebook
+runs through its TFEstimator.
+
+The passenger manifest is generated synthetically (this environment has no
+egress) with the classic dataset's schema and survival structure — sex, class
+and age drive the outcome — so the model has real signal to learn: expect
+validation accuracy well above the 0.62 majority-class floor.
+
+Run: ``python examples/titanic_keras.py [--rows 2000] [--epochs 8]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+
+def generate_titanic(rows: int, seed: int = 7) -> pd.DataFrame:
+    """A Titanic-shaped manifest whose survival follows the classic data's
+    dominant effects (sex >> class > age), with noise and missing ages."""
+    rng = np.random.RandomState(seed)
+    pclass = rng.choice([1, 2, 3], size=rows, p=[0.24, 0.21, 0.55])
+    sex = rng.choice(["male", "female"], size=rows, p=[0.65, 0.35])
+    age = np.clip(rng.normal(29.7, 14.5, size=rows), 0.4, 80.0).round(1)
+    sibsp = rng.poisson(0.5, size=rows)
+    parch = rng.poisson(0.4, size=rows)
+    fare = np.where(pclass == 1, rng.gamma(3.0, 28.0, rows),
+                    np.where(pclass == 2, rng.gamma(3.0, 7.0, rows),
+                             rng.gamma(2.0, 7.0, rows))).round(2)
+    embarked = rng.choice(["S", "C", "Q"], size=rows, p=[0.72, 0.19, 0.09])
+
+    logit = (-0.9
+             + 2.6 * (sex == "female")
+             + 0.95 * (pclass == 1) + 0.45 * (pclass == 2)
+             - 0.018 * (age - 29.7)
+             - 0.18 * np.maximum(sibsp + parch - 1, 0)
+             + rng.normal(0.0, 0.8, size=rows))
+    survived = (rng.random_sample(rows) < 1 / (1 + np.exp(-logit))).astype(
+        np.int64)
+
+    age_missing = rng.random_sample(rows) < 0.2  # like the real manifest
+    return pd.DataFrame({
+        "PassengerId": np.arange(1, rows + 1),
+        "Survived": survived,
+        "Pclass": pclass,
+        "Sex": sex,
+        "Age": np.where(age_missing, np.nan, age),
+        "SibSp": sibsp,
+        "Parch": parch,
+        "Fare": fare,
+        "Embarked": embarked,
+    })
+
+
+FEATURES = ["pclass_1", "pclass_2", "is_female", "age", "sibsp", "parch",
+            "fare", "embarked_c", "embarked_q"]
+LABEL = "Survived"
+
+
+def preprocess(df):
+    """Distributed cleanup + encoding (the notebook's pandas-on-Spark cell,
+    expressed on the ETL engine): impute Age, binary/one-hot encode the
+    categoricals, drop identifiers."""
+    from raydp_tpu.etl.expressions import col
+
+    df = df.fillna(29.7, subset=["Age"])  # median-age imputation
+    df = (df
+          .withColumn("is_female", col("Sex") == "female")
+          .withColumn("pclass_1", col("Pclass") == 1)
+          .withColumn("pclass_2", col("Pclass") == 2)
+          .withColumn("embarked_c", col("Embarked") == "C")
+          .withColumn("embarked_q", col("Embarked") == "Q")
+          # standardize the numeric columns: unscaled age/fare dominate the
+          # gradient and stall the small MLP
+          .withColumn("age", (col("Age") - 29.7) / 14.5)
+          .withColumn("fare", (col("Fare") - 30.0) / 40.0)
+          .withColumn("sibsp", col("SibSp") / 2.0)
+          .withColumn("parch", col("Parch") / 2.0))
+    return df.select(LABEL, *FEATURES)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=2000)
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    import raydp_tpu
+    from raydp_tpu.train import KerasEstimator
+    from raydp_tpu.utils import random_split
+
+    csv_path = os.path.join(tempfile.mkdtemp(prefix="rdt-titanic-"),
+                            "titanic.csv")
+    generate_titanic(args.rows).to_csv(csv_path, index=False)
+
+    session = raydp_tpu.init("titanic", num_executors=2, executor_cores=1,
+                             executor_memory="512MB")
+    try:
+        data = session.read.csv(csv_path, num_partitions=4)
+        data = preprocess(data)
+        train_df, test_df = random_split(data, [0.8, 0.2], seed=0)
+
+        def build_model():
+            import keras
+            return keras.Sequential([
+                keras.layers.Input(shape=(len(FEATURES),)),
+                keras.layers.Dense(32, activation="relu"),
+                keras.layers.Dense(16, activation="relu"),
+                keras.layers.Dense(1, activation="sigmoid"),
+            ])
+
+        est = KerasEstimator(
+            model_builder=build_model,
+            optimizer="adam",
+            loss="binary_crossentropy",
+            metrics=["accuracy"],
+            feature_columns=FEATURES,
+            label_column=LABEL,
+            batch_size=args.batch_size,
+            num_epochs=args.epochs,
+            seed=0,
+        )
+        result = est.fit_on_frame(train_df, test_df)
+        last = result.history[-1]
+        print(f"final: loss={last['loss']:.4f} "
+              f"acc={last.get('binary_accuracy', last.get('accuracy')):.4f} "
+              f"val_acc={last.get('val_binary_accuracy', last.get('val_accuracy')):.4f}")
+
+        val_acc = last.get("val_binary_accuracy", last.get("val_accuracy"))
+        if val_acc is None or val_acc < 0.70:
+            print("FAILED: validation accuracy below 0.70", file=sys.stderr)
+            return 1
+        # sanity: the model actually discriminates — sex is the loudest signal
+        model = est.get_model()
+        # rows in FEATURES order, numeric columns pre-standardized as above
+        female_1st = np.array([[1, 0, 1, 0.0, 0, 0, 1.25, 1, 0]], np.float32)
+        male_3rd = np.array([[0, 0, 0, 0.0, 0, 0, -0.55, 0, 0]], np.float32)
+        p_f = float(model.predict(female_1st, verbose=0)[0, 0])
+        p_m = float(model.predict(male_3rd, verbose=0)[0, 0])
+        print(f"P(survive | 1st-class female) = {p_f:.3f}, "
+              f"P(survive | 3rd-class male) = {p_m:.3f}")
+        if not p_f > p_m:
+            print("FAILED: survival ordering wrong", file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        raydp_tpu.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

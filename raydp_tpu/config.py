@@ -39,6 +39,10 @@ HEARTBEAT_INTERVAL_S_KEY = f"{NAMESPACE}.failure.heartbeat_interval_s"
 HEARTBEAT_TIMEOUT_S_KEY = f"{NAMESPACE}.failure.heartbeat_timeout_s"
 TRACE_DIR_KEY = f"{NAMESPACE}.trace.dir"
 NATIVE_OBJECT_STORE_KEY = f"{NAMESPACE}.object_store.native"   # use C++ store core
+#: shared-memory budget before sealed objects LRU-spill to disk; defaults to
+#: the arena size (plasma eviction parity). "0" disables spilling.
+SPILL_BUDGET_KEY = f"{NAMESPACE}.object_store.shm_budget"
+SPILL_DIR_KEY = f"{NAMESPACE}.object_store.spill_dir"
 
 _DEFAULTS: Dict[str, str] = {
     EXECUTOR_RESTARTS_KEY: "-1",

@@ -38,6 +38,7 @@ class _Context:
         placement_group_strategy: Optional[str],
         configs: Optional[Dict[str, str]],
         virtual_nodes: Optional[List[Dict[str, float]]],
+        address: Optional[str] = None,
     ):
         self.app_name = app_name
         self.num_executors = num_executors
@@ -46,14 +47,37 @@ class _Context:
         self.placement_group_strategy = placement_group_strategy
         self.config = Config(configs)
         self.virtual_nodes = virtual_nodes
+        self.address = address
         self.session = None
         self._placement_group = None
+        self._kept_data = False  # a stop(cleanup_data=False) happened
 
     def get_or_create_session(self):
         if self.session is not None:
             return self.session
         from raydp_tpu.etl.session import Session
         from raydp_tpu.runtime import init_runtime
+
+        if self.address is not None:
+            # attach/client mode: join a standalone head's cluster instead of
+            # booting an in-process runtime (parity: Ray-client mode,
+            # reference conftest.py:77-140)
+            if self.placement_group_strategy is not None:
+                raise NotImplementedError(
+                    "placement_group_strategy is not supported in attach "
+                    "mode yet; create groups on the head side")
+            from raydp_tpu.runtime.client import ClientContext
+            from raydp_tpu.runtime.head import adopt_runtime
+            adopt_runtime(ClientContext(self.address))
+            self.session = Session(
+                app_name=self.app_name,
+                num_executors=self.num_executors,
+                executor_cores=self.executor_cores,
+                executor_memory=self.executor_memory,
+                config=self.config,
+            )
+            self.session.start()
+            return self.session
 
         runtime = init_runtime(config=self.config, virtual_nodes=self.virtual_nodes)
 
@@ -88,6 +112,7 @@ class _Context:
         stop → remove placement group → runtime shutdown (unless data is kept)."""
         from raydp_tpu.runtime import get_runtime, runtime_initialized, shutdown_runtime
 
+        self._kept_data = not cleanup_data
         if self.session is not None:
             self.session.stop(cleanup_data=cleanup_data)
             if cleanup_data:
@@ -126,6 +151,7 @@ def init(
     placement_group_strategy: Optional[str] = None,
     configs: Optional[Dict[str, str]] = None,
     virtual_nodes: Optional[List[Dict[str, float]]] = None,
+    address: Optional[str] = None,
 ):
     """Start the framework and return the ETL :class:`Session`.
 
@@ -135,6 +161,12 @@ def init(
     TPU-build-specific knob: ``virtual_nodes`` registers logical nodes to simulate
     a multi-host topology in tests (the reference's tests get this from
     ``ray.cluster_utils.Cluster``, test_spark_cluster.py:90-110).
+
+    ``address="host:port"`` attaches to a standalone head
+    (``python -m raydp_tpu.runtime.head --listen``) instead of booting an
+    in-process runtime — the Ray-client-mode analogue. The head, its actors,
+    and stored data outlive this driver; ``stop(cleanup_data=False)`` leaves
+    even this session's master alive for the next driver to read.
     """
     sub = _submit_overrides()
     app_name = app_name or sub.get("app_name") or "raydp-tpu"
@@ -146,6 +178,8 @@ def init(
         executor_memory = sub.get("executor_memory", "1GB")
     if placement_group_strategy is None:
         placement_group_strategy = sub.get("placement_group_strategy")
+    if address is None:
+        address = sub.get("address")
     merged_configs = dict(sub.get("configs", {}))
     merged_configs.update(configs or {})
     configs = merged_configs or None
@@ -157,7 +191,8 @@ def init(
         try:
             _global_context = _Context(
                 app_name, num_executors, executor_cores, executor_memory,
-                placement_group_strategy, configs, virtual_nodes)
+                placement_group_strategy, configs, virtual_nodes,
+                address=address)
             return _global_context.get_or_create_session()
         except BaseException:
             if _global_context is not None:
@@ -187,4 +222,30 @@ def active_session():
         return _global_context.session if _global_context is not None else None
 
 
-atexit.register(stop)  # parity: context.py:257
+def _atexit_stop() -> None:
+    """Process-exit sweep. Honors an earlier explicit
+    ``stop(cleanup_data=False)``: the implicit exit must NOT reap the master
+    that call deliberately kept — in attach mode that master (and the data it
+    owns on the standalone head) is exactly what the next driver reads
+    (parity: ownership survives driver exit, reference dataset.py:137-158)."""
+    global _global_context
+    with _context_lock:
+        ctx = _global_context
+        if ctx is None:
+            return
+        try:
+            if ctx._kept_data:
+                from raydp_tpu.runtime import (
+                    runtime_initialized, shutdown_runtime,
+                )
+                if runtime_initialized():
+                    shutdown_runtime()  # client mode: detach only
+            else:
+                ctx.stop(True)
+        except Exception:
+            pass
+        finally:
+            _global_context = None
+
+
+atexit.register(_atexit_stop)  # parity: context.py:257

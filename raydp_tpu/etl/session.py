@@ -21,9 +21,7 @@ from raydp_tpu import config as cfg
 from raydp_tpu.config import Config
 from raydp_tpu.etl import plan as P
 from raydp_tpu.etl.engine import Engine, ExecutorPool
-from raydp_tpu.etl.executor import EtlExecutor
 from raydp_tpu.etl.frame import DataFrame
-from raydp_tpu.etl.master import EtlMaster
 from raydp_tpu.log import get_logger
 from raydp_tpu.runtime import get_runtime
 from raydp_tpu.runtime.actor import ActorHandle
@@ -43,23 +41,30 @@ class Session:
         self.placement_group = placement_group
         self.master_name = f"{app_name}_MASTER"
         self.master: Optional[ActorHandle] = None
-        self.executors: List[ActorHandle] = []
+        self.cluster = None  # EtlCluster after start()
         self.engine: Optional[Engine] = None
         self._cached_frames: Dict[str, P.CachedScan] = {}
         self._stopped = False
-        self._next_executor_index = 0
+
+    @property
+    def executors(self) -> List[ActorHandle]:
+        return self.cluster.workers if self.cluster is not None else []
 
     # ---- lifecycle ----------------------------------------------------------
     def start(self) -> "Session":
-        rt = get_runtime()
+        """Bring-up through the generic :class:`~raydp_tpu.cluster.Cluster`
+        surface (reference services.py:22-90): the built-in engine is an
+        :class:`EtlCluster`; an external engine subclasses ``Cluster`` and
+        rides the same lifecycle."""
+        from raydp_tpu.cluster import EtlCluster
+
         master_resources = self.config.resource_map(
             cfg.MASTER_ACTOR_RESOURCE_PREFIX)
-        self.master = rt.create_actor(
-            EtlMaster, (self.app_name,), name=self.master_name,
-            resources=master_resources, max_restarts=0, max_concurrency=8)
+        self.cluster = EtlCluster(self.app_name, master_resources)
+        self.master = self.cluster.master.handle
 
         for _ in range(self.num_executors):
-            self.executors.append(self._launch_executor(block=False))
+            self._launch_executor(block=False)
         for h in self.executors:
             h.wait_ready()
 
@@ -75,29 +80,25 @@ class Session:
         return self
 
     def _launch_executor(self, block: bool = True) -> ActorHandle:
-        rt = get_runtime()
         executor_resources = {"CPU": float(self.executor_cores),
                               "memory": float(self.executor_memory)}
         executor_resources.update(
             self.config.resource_map(cfg.EXECUTOR_ACTOR_RESOURCE_PREFIX))
         max_restarts = self.config.get_int(cfg.EXECUTOR_RESTARTS_KEY, -1)
-        i = self._next_executor_index
-        self._next_executor_index += 1
         pg_id, bundle = None, None
         if self.placement_group is not None:
             pg_id = self.placement_group.group_id
-            bundle = i % len(self.placement_group.bundles)
-        return rt.create_actor(
-            EtlExecutor, (self.master_name,),
-            name=f"rdt-executor-{self.app_name}-{i}",
-            resources=executor_resources,
+            bundle = (self.cluster._worker_index
+                      % len(self.placement_group.bundles))
+        self.cluster.add_worker(
+            executor_resources,
             max_restarts=max_restarts,
             max_concurrency=max(2, self.executor_cores),
-            env={"JAX_PLATFORMS": "cpu"},  # ETL actors must never grab TPU chips
             placement_group=pg_id,
             bundle_index=bundle,
             block=block,
         )
+        return self.cluster.workers[-1]
 
     def _executor_hosts(self) -> Dict[str, str]:
         """Executor name → data-plane host id, for locality-aware scheduling
@@ -125,17 +126,12 @@ class Session:
         if total < 1:
             raise ValueError("need at least one executor")
         while len(self.executors) > total:
-            handle = self.executors.pop()
-            try:
-                handle.kill(no_restart=True)
-            except Exception:
-                pass
+            self.cluster.remove_worker()
         added = []
-        while len(self.executors) + len(added) < total:
+        while len(self.executors) < total:
             added.append(self._launch_executor(block=False))
         for h in added:
             h.wait_ready()
-        self.executors.extend(added)
         if self.engine is not None:
             self.engine.pool = ExecutorPool(
                 self.executors, hosts_by_name=self._executor_hosts())
@@ -148,17 +144,16 @@ class Session:
         still reaps the master (parity: ray_cluster_master.py:236-247)."""
         if not self._stopped:
             self._stopped = True
-            for h in self.executors:
+            if self.cluster is not None:
+                self.cluster.stop(cleanup_master=False)
+        if cleanup_data and self.master is not None:
+            if self.cluster is not None:
+                self.cluster.stop(cleanup_master=True)
+            else:
                 try:
-                    h.kill(no_restart=True)
+                    self.master.kill(no_restart=True)
                 except Exception:
                     pass
-            self.executors = []
-        if cleanup_data and self.master is not None:
-            try:
-                self.master.kill(no_restart=True)
-            except Exception:
-                pass
             self.master = None
         logger.info("session %s stopped (cleanup_data=%s)",
                     self.app_name, cleanup_data)

@@ -132,15 +132,25 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden: bool = False):
+        """``return_hidden=True`` yields the post-norm hidden states [B,T,D]
+        (the lm_head weight is still created so the param tree is identical);
+        pair it with :func:`lm_loss_fused`, which applies the head per
+        T-chunk so the [B,T,V] float32 logits never materialize — at 32k
+        vocab and T=8192 those logits are ~2 GB per direction of pure HBM
+        traffic, the single largest non-kernel cost in the train step."""
         x = nn.Embed(self.vocab_size, self.dim, name="embed",
                      dtype=self.dtype)(tokens)
         for i in range(self.num_layers):
             x = Block(self.num_heads, self.mlp_ratio, self.attention,
                       self.mesh, self.dtype, name=f"block_{i}")(x)
         x = RMSNorm(name="ln_f")(x)
-        return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
-                        name="lm_head")(x).astype(jnp.float32)
+        head = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
+                        name="lm_head")
+        if return_hidden:
+            head(x[:, :1])  # registers the kernel (result DCE'd); the head
+            return x        # itself is applied chunk-wise by lm_loss_fused
+        return head(x).astype(jnp.float32)
 
 
 def lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -149,6 +159,52 @@ def lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
 
     return optax.softmax_cross_entropy_with_integer_labels(
         logits[:, :-1], tokens[:, 1:]).mean()
+
+
+def lm_loss_fused(hidden: jnp.ndarray, lm_head_kernel: jnp.ndarray,
+                  tokens: jnp.ndarray, chunk: int = 1024,
+                  remat: bool = True) -> jnp.ndarray:
+    """Next-token cross entropy with the lm_head FUSED into the loss.
+
+    The head matmul + softmax-CE run per T-chunk of ``chunk`` positions under
+    ``jax.checkpoint`` inside a ``lax.scan``: forward keeps only the hidden
+    states (already live) and per-chunk scalars, backward recomputes each
+    chunk's logits — peak logits footprint is ``B×chunk×V`` instead of
+    ``B×T×V`` f32 (64× smaller at T=8192/chunk=1024/f32), while each chunk
+    matmul ``[B·chunk, D] @ [D, V]`` stays MXU-sized. This trades one extra
+    head matmul (recompute) for ~4 GB of HBM round-trips per step at the
+    bench shape, which is bandwidth the step actually runs out of — the
+    round-2 gap between kernel MFU (51%) and e2e MFU (35%).
+
+    ``hidden`` [B, T, D] from ``model(tokens, return_hidden=True)``;
+    ``lm_head_kernel`` [D, V] = ``params["lm_head"]["kernel"]``.
+    """
+    import optax
+    from jax import lax
+
+    B, T, D = hidden.shape
+    x = hidden[:, :-1]                   # predict positions 1..T-1
+    y = tokens[:, 1:]
+    n = T - 1
+    pad = (-n) % chunk
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        y = jnp.pad(y, ((0, 0), (0, pad)))
+    mask = (jnp.arange(n + pad) < n).astype(jnp.float32)[None, :]
+    nchunks = (n + pad) // chunk
+    xs = x.reshape(B, nchunks, chunk, D).swapaxes(0, 1)      # [N, B, C, D]
+    ys = y.reshape(B, nchunks, chunk).swapaxes(0, 1)         # [N, B, C]
+    ms = mask.reshape(1, nchunks, chunk).swapaxes(0, 1)      # [N, 1, C]
+
+    def chunk_ce(total, xyz):
+        xc, yc, mc = xyz
+        logits = (xc @ lm_head_kernel.astype(xc.dtype)).astype(jnp.float32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, yc)
+        return total + (ce * mc).sum(), None
+
+    body = jax.checkpoint(chunk_ce) if remat else chunk_ce
+    total, _ = lax.scan(body, jnp.zeros((), jnp.float32), (xs, ys, ms))
+    return total / (B * n)
 
 
 def transformer_param_rules(axis: str = "tensor"):

@@ -1,0 +1,150 @@
+"""Client (attach) mode: a driver joining a standalone head's cluster.
+
+Parity: the reference runs its whole test matrix in both direct mode and
+Ray-client mode (reference conftest.py:77-140 parametrizes ``ray.init`` vs
+``ray.init("ray://...")``), and its data survives driver exit because the Ray
+head outlives drivers. Here a standalone head process
+(``python -m raydp_tpu.runtime.head --listen``) owns the cluster — actors,
+names, placement, and the object-store table — and any number of sequential
+or concurrent drivers attach with ``raydp_tpu.init(..., address="host:port")``.
+Detaching (or crashing) a driver leaves the head, its actors, and the store
+intact; a later driver can resolve the same named actors and read the same
+objects (ownership-transferred datasets survive exactly like
+``stop_spark(cleanup_data=False)``, reference dataset.py:137-158).
+
+:class:`ClientContext` implements the slice of the RuntimeContext protocol the
+rest of the framework uses (``create_actor`` / ``get_actor`` / store client /
+session metadata), routed over the head RPC instead of in-process calls.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Dict, Optional
+
+from raydp_tpu.log import get_logger
+from raydp_tpu.runtime import object_store as objstore
+from raydp_tpu.runtime.actor import ActorHandle, ActorSpec, dump_spec
+from raydp_tpu.runtime.object_store import ObjectStoreClient
+from raydp_tpu.runtime.rpc import connect_with_retry
+
+logger = get_logger("client")
+
+
+class _StoreTableProxy:
+    """Forwards ObjectStoreServer table methods to the head over RPC (same
+    shape as the actor bootstrap's proxy, actor_main.py)."""
+
+    def __init__(self, head):
+        self._head = head
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        method = f"store_{item}"
+
+        def _call(*args):
+            return self._head.call(method, *args)
+
+        return _call
+
+
+class ClientContext:
+    """A driver attached to a standalone head. Runtime-protocol compatible
+    where the framework needs it; everything rides the head RPC."""
+
+    is_client = True
+
+    def __init__(self, address: str):
+        host, port = address.rsplit(":", 1)
+        self.address = (host, int(port))
+        self.head = connect_with_retry(self.address)
+        info = self.head.call("attach_driver",
+                              f"driver-{uuid.uuid4().hex[:8]}")
+        self.session_id = info["session_id"]
+        self.session_dir = info["session_dir"]
+        self.driver_id = info["driver_id"]
+        #: empty on purpose: records live in the head; locality helpers
+        #: degrade gracefully (Session._executor_hosts finds no entries)
+        self.records: Dict[str, Any] = {}
+        self._lock = threading.RLock()
+
+        # data plane: on the head's machine we map its shared memory
+        # zero-copy; from another machine we fall back to head-mediated
+        # payload RPCs (the store's explicit remote mode)
+        same_machine = host in ("127.0.0.1", "localhost") \
+            or host == self.head.local_host
+        self.store_client = ObjectStoreClient(
+            _StoreTableProxy(self.head), self.session_id,
+            default_owner=objstore.DRIVER_OWNER,
+            remote=not same_machine)
+        objstore.set_client(self.store_client)
+        logger.info("attached to head at %s (session %s, %s)",
+                    address, self.session_id[:12],
+                    "same-machine" if same_machine else "remote")
+
+    # ---- actors (the subset RuntimeContext exposes in-process) --------------
+    def create_actor(
+        self,
+        cls,
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        *,
+        name: Optional[str] = None,
+        resources: Optional[Dict[str, float]] = None,
+        max_restarts: int = 0,
+        max_concurrency: int = 2,
+        env: Optional[Dict[str, str]] = None,
+        node_id: Optional[str] = None,
+        placement_group: Optional[str] = None,
+        bundle_index: Optional[int] = None,
+        block: bool = True,
+    ) -> ActorHandle:
+        cls_bytes, args_bytes = dump_spec(cls, args, kwargs or {})
+        spec = ActorSpec(
+            actor_id=f"actor-{uuid.uuid4().hex[:12]}",
+            name=name,
+            cls_bytes=cls_bytes,
+            args_bytes=args_bytes,
+            resources=dict(resources or {}),
+            max_restarts=max_restarts,
+            max_concurrency=max_concurrency,
+            env=dict(env or {}),
+            node_id=node_id,
+            placement_group_id=placement_group,
+            bundle_index=bundle_index,
+        )
+        actor_id = self.head.call("create_actor", spec.__dict__, False,
+                                  timeout=60.0)
+        handle = ActorHandle(actor_id, name, self.address)
+        if block:
+            handle.wait_ready()
+        return handle
+
+    def get_actor(self, name: str) -> Optional[ActorHandle]:
+        actor_id = self.head.call("get_named_actor", name)
+        if actor_id is None:
+            return None
+        return ActorHandle(actor_id, name, self.address)
+
+    def store_host_of_node(self, node_id: Optional[str]) -> str:
+        return objstore.HEAD_HOST
+
+    def list_nodes(self):
+        return self.head.call("list_nodes")
+
+    # ---- lifecycle ----------------------------------------------------------
+    def shutdown(self) -> None:
+        """Detach. The head, its actors, and the store stay up for the next
+        driver — this is the whole point of attach mode."""
+        try:
+            self.store_client.close()
+        except Exception:
+            pass
+        objstore.set_client(None)
+        try:
+            self.head.close()
+        except Exception:
+            pass
+        logger.info("detached from head (session %s)", self.session_id[:12])

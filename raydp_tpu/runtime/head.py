@@ -258,6 +258,17 @@ class HeadService:
     def ping(self) -> str:
         return "pong"
 
+    # ---- attach/client mode -------------------------------------------------
+    def attach_driver(self, driver_id: str) -> Dict[str, Any]:
+        """A driver joins this (standalone) head as a client — parity with
+        Ray-client mode in the reference's test matrix (conftest.py:77-140).
+        Nothing is driver-scoped here: names, actors, and stored objects all
+        belong to the head's session, so they survive driver exits."""
+        logger.info("driver %s attached", driver_id)
+        return {"session_id": self._rt.session_id,
+                "session_dir": self._rt.session_dir,
+                "driver_id": driver_id}
+
 
 def _terminate(proc) -> None:
     """Kill a local Popen (whole process group) or a remote agent process."""
@@ -289,7 +300,8 @@ class RuntimeContext:
     """Singleton runtime: head services + supervisor + driver-side store client."""
 
     def __init__(self, config: Optional[Config] = None,
-                 virtual_nodes: Optional[List[Dict[str, float]]] = None):
+                 virtual_nodes: Optional[List[Dict[str, float]]] = None,
+                 listen_host: str = "127.0.0.1", listen_port: int = 0):
         self.config = config or Config()
         self.session_id = uuid.uuid4().hex
         self.session_dir = os.path.join(
@@ -298,8 +310,19 @@ class RuntimeContext:
         init_logging("driver", self.config.get(cfg.LOG_LEVEL_KEY, "INFO"),
                      os.path.join(self.session_dir, "logs"), self.session_id)
 
+        arena = self._create_arena()
+        # eviction/spill budget: configured value, else the arena capacity
+        # (no arena → default arena size); "0" disables spilling
+        budget = self.config.get_memory(
+            cfg.SPILL_BUDGET_KEY,
+            default=(arena.size if arena is not None
+                     else _default_arena_size()))
+        spill_dir = (self.config.get(cfg.SPILL_DIR_KEY)
+                     or os.path.join(self.session_dir, "spill")) \
+            if budget > 0 else None
         self.store_server = ObjectStoreServer(
-            self.session_id, arena=self._create_arena())
+            self.session_id, arena=arena,
+            spill_dir=spill_dir, shm_budget=budget or None)
         self.resource_manager = ResourceManager()
         if virtual_nodes:
             for res in virtual_nodes:
@@ -321,8 +344,9 @@ class RuntimeContext:
         self._stopped = threading.Event()
 
         self.service = HeadService(self)
-        self.server = RpcServer(MethodDispatcher(self.service), max_concurrency=16,
-                                name="head")
+        self.server = RpcServer(MethodDispatcher(self.service),
+                                host=listen_host, port=listen_port,
+                                max_concurrency=16, name="head")
         self.store_client = ObjectStoreClient(self.store_server, self.session_id,
                                               default_owner=objstore.DRIVER_OWNER)
         objstore.set_client(self.store_client)
@@ -817,6 +841,17 @@ def init_runtime(config: Optional[Config] = None,
         return _runtime
 
 
+def adopt_runtime(rt) -> None:
+    """Install a runtime-protocol object as the process-global runtime — the
+    attach path (``raydp_tpu.init(address=...)`` installs a
+    :class:`~raydp_tpu.runtime.client.ClientContext`)."""
+    global _runtime
+    with _runtime_lock:
+        if _runtime is not None:
+            raise RuntimeError("runtime already initialized in this process")
+        _runtime = rt
+
+
 def get_runtime() -> RuntimeContext:
     if _runtime is None:
         raise RuntimeError("runtime not initialized; call raydp_tpu.init() first")
@@ -833,3 +868,44 @@ def shutdown_runtime() -> None:
         if _runtime is not None:
             _runtime.shutdown()
             _runtime = None
+
+
+def main() -> None:
+    """Standalone head: a cluster that outlives (and is shared by) drivers.
+
+    ``python -m raydp_tpu.runtime.head --listen [--port N] [--host H]``
+    prints ``RDT_HEAD_READY <host:port>`` once serving; drivers attach with
+    ``raydp_tpu.init(app, address="host:port")``. Parity: the Ray head node
+    the reference's client-mode matrix connects to (conftest.py:77-140) and
+    the driver-outliving cluster of test_spark_cluster.py:113-134."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="raydp_tpu standalone head (attach/client mode)")
+    ap.add_argument("--listen", action="store_true", required=True,
+                    help="serve until killed")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 picks a free port (printed on stdout)")
+    ap.add_argument("--cpus", type=float, default=None,
+                    help="CPU resource of the head node (default: all)")
+    args = ap.parse_args()
+
+    virtual_nodes = None
+    if args.cpus is not None:
+        virtual_nodes = [{"CPU": args.cpus,
+                          "memory": _default_node_resources()["memory"]}]
+    rt = RuntimeContext(listen_host=args.host, listen_port=args.port,
+                        virtual_nodes=virtual_nodes)
+    print(f"RDT_HEAD_READY {rt.server.url}", flush=True)
+    try:
+        while True:
+            time.sleep(3600.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        rt.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -87,6 +87,8 @@ class _Entry:
     host_id: str = HEAD_HOST  # machine holding the payload
     payload_addr: Optional[str] = None  # "host:port" serving cross-host fetches
     sealed: bool = True
+    spilled: bool = False      # payload currently on disk, not in shm
+    last_access: float = 0.0   # monotonic; LRU clock for spilling
 
 
 class PayloadHost:
@@ -174,38 +176,46 @@ class PayloadHost:
         return segment_name, -1
 
     # -- release ---------------------------------------------------------------
-    def release(self, items: List[Tuple[str, int]]) -> int:
+    def release(self, items: List[Tuple[str, int]],
+                defer_segments: bool = False) -> int:
         """Release payloads: ``(segment, offset)`` pairs. Arena offsets are
-        deferred for the view-grace period; dedicated segments unlink now."""
+        deferred for the view-grace period; dedicated segments unlink now —
+        unless ``defer_segments`` (the spill path uses it: a reader that
+        looked the object up but has not yet attached the segment must still
+        find the name for the grace period; unlink preserves only mappings
+        that already exist)."""
         import time as _time
         due = _time.monotonic() + self.ARENA_FREE_GRACE_S
         n = 0
-        for segment, offset in items:
-            if offset >= 0:
-                with self._arena_lock:
+        with self._arena_lock:
+            for segment, offset in items:
+                if offset >= 0:
                     if self._arena is not None:
-                        self._deferred.append((due, int(offset)))
-            else:
-                _unlink_segment(segment)
-            n += 1
+                        self._deferred.append((due, "arena", int(offset)))
+                elif defer_segments:
+                    self._deferred.append((due, "segment", segment))
+                else:
+                    _unlink_segment(segment)
+                n += 1
         self._reap_deferred()
         return n
 
     def _reap_deferred(self, everything: bool = False) -> None:
-        """Free arena offsets whose grace period elapsed (activity-driven:
+        """Free deferred payloads whose grace period elapsed (activity-driven:
         called on frees and seals; shutdown reaps everything)."""
         import time as _time
         now = _time.monotonic()
         with self._arena_lock:
-            if self._arena is None:
-                self._deferred.clear()
-                return
             keep = []
-            for due, offset in self._deferred:
+            for due, kind, payload in self._deferred:
                 if everything or due <= now:
-                    self._arena.free(offset)
+                    if kind == "arena":
+                        if self._arena is not None:
+                            self._arena.free(payload)
+                    else:
+                        _unlink_segment(payload)
                 else:
-                    keep.append((due, offset))
+                    keep.append((due, kind, payload))
             self._deferred = keep
 
     def shutdown(self) -> None:
@@ -227,7 +237,9 @@ class ObjectStoreServer:
     to the owning node's agent RPC.
     """
 
-    def __init__(self, session_id: str, arena=None):
+    def __init__(self, session_id: str, arena=None,
+                 spill_dir: Optional[str] = None,
+                 shm_budget: Optional[int] = None):
         self.session_id = session_id
         self.host = PayloadHost(arena)
         self._lock = threading.Lock()
@@ -238,6 +250,16 @@ class ObjectStoreServer:
         # callbacks wired by RuntimeContext for payloads on agent machines
         self.node_release = None  # (host_id, [(segment, offset)]) -> None
         self.node_fetch = None    # (host_id, segment, offset, size) -> bytes
+        # eviction/spill (plasma parity): sealed head-host objects LRU-spill
+        # to disk once their shm footprint exceeds the budget; lookups fault
+        # them back in transparently. Disabled when spill_dir is None.
+        self.spill_dir = spill_dir
+        self.shm_budget = shm_budget
+        self._shm_bytes = 0        # unspilled head-host payload bytes
+        self._spilled_bytes = 0
+        self._spill_io_lock = threading.Lock()  # one spill/fault-in at a time
+        self._fault_gen = 0        # fault-in segments get fresh names (the
+        #                            old name may still be alive under grace)
 
     # -- arena (head machine) --------------------------------------------------
     def arena_info(self) -> Optional[Dict[str, Any]]:
@@ -253,12 +275,104 @@ class ObjectStoreServer:
     def seal(self, object_id: str, segment: str, size: int, kind: str,
              owner: str, offset: int = -1, host_id: str = HEAD_HOST,
              payload_addr: Optional[str] = None) -> None:
+        import time as _time
         with self._lock:
             if object_id in self._table:
                 raise KeyError(f"object {object_id} already sealed")
             self._table[object_id] = _Entry(segment, size, kind, owner, offset,
-                                            host_id, payload_addr)
+                                            host_id, payload_addr,
+                                            last_access=_time.monotonic())
+            if host_id == HEAD_HOST:
+                self._shm_bytes += size
         self.host.reap()
+        self._maybe_spill(exclude=object_id)
+
+    # -- eviction/spill --------------------------------------------------------
+    def _spill_path(self, object_id: str) -> str:
+        return os.path.join(self.spill_dir, object_id)
+
+    def _maybe_spill(self, exclude: Optional[str] = None) -> None:
+        """LRU-spill sealed head-host objects until shm use fits the budget.
+        Arena bytes are released on the usual view-grace deferral and
+        dedicated segments unlink (mapped readers keep their views), so a
+        borrowed zero-copy view never sees recycled bytes. Parity: plasma's
+        eviction/spill under memory pressure."""
+        if self.spill_dir is None or not self.shm_budget:
+            return
+        while True:
+            with self._lock:
+                if self._shm_bytes <= self.shm_budget:
+                    return
+                victims = sorted(
+                    ((e.last_access, oid) for oid, e in self._table.items()
+                     if e.host_id == HEAD_HOST and not e.spilled
+                     and e.size > 0 and oid != exclude))
+                if not victims:
+                    return
+                victim = victims[0][1]
+            if not self._spill_one(victim):
+                return
+
+    def _spill_one(self, object_id: str) -> bool:
+        with self._spill_io_lock:
+            with self._lock:
+                e = self._table.get(object_id)
+                if e is None or e.spilled or e.host_id != HEAD_HOST:
+                    return False
+                segment, offset, size = e.segment, e.offset, e.size
+            try:
+                data = self.host.fetch(segment, offset, size)
+                os.makedirs(self.spill_dir, exist_ok=True)
+                tmp = self._spill_path(object_id) + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, self._spill_path(object_id))
+            except Exception as exc:  # pragma: no cover - disk trouble
+                logger.warning("spill of %s failed: %s", object_id, exc)
+                return False
+            with self._lock:
+                e = self._table.get(object_id)
+                if e is None:  # freed while we were writing: drop the file
+                    _remove_quiet(self._spill_path(object_id))
+                    return True
+                e.spilled = True
+                e.segment, e.offset = "", -1
+                self._shm_bytes -= size
+                self._spilled_bytes += size
+            # defer the segment unlink too: a reader between lookup and
+            # attach must still find the name during the grace window
+            self.host.release([(segment, offset)], defer_segments=True)
+            return True
+
+    def _fault_in(self, object_id: str) -> None:
+        """Bring a spilled payload back into shm (transparent on lookup)."""
+        import time as _time
+        with self._spill_io_lock:
+            with self._lock:
+                e = self._table.get(object_id)
+                if e is None or not e.spilled:
+                    return  # raced with another fault-in or a free
+                size = e.size
+            path = self._spill_path(object_id)
+            with open(path, "rb") as f:
+                data = f.read()
+            self._fault_gen += 1
+            seg_name = (f"rdt{self.session_id[:8]}_{object_id[:20]}"
+                        f"g{self._fault_gen}")
+            segment, offset = self.host.write(data, seg_name)
+            with self._lock:
+                e = self._table.get(object_id)
+                if e is None:  # freed mid-fault-in
+                    self.host.release([(segment, offset)])
+                    _remove_quiet(path)
+                    return
+                e.segment, e.offset = segment, offset
+                e.spilled = False
+                e.last_access = _time.monotonic()
+                self._shm_bytes += size
+                self._spilled_bytes -= size
+            _remove_quiet(path)
+        self._maybe_spill(exclude=object_id)
 
     # -- head-mediated payload path (clients with NO shared memory at all) -----
     def fetch_payload(self, object_id: str) -> Tuple[bytes, str]:
@@ -290,12 +404,23 @@ class ObjectStoreServer:
     # -- read path ------------------------------------------------------------
     def lookup(self, object_id: str
                ) -> Tuple[str, int, str, int, str, Optional[str]]:
-        with self._lock:
-            e = self._table.get(object_id)
-            if e is None:
-                raise KeyError(f"object {object_id} not found")
-            return (e.segment, e.size, e.kind, e.offset, e.host_id,
-                    e.payload_addr)
+        import time as _time
+        # a concurrent seal can re-evict the object between our fault-in and
+        # re-read (it is the LRU victim when it is the only candidate): retry
+        # a few rounds rather than failing a live ref
+        for _ in range(4):
+            with self._lock:
+                e = self._table.get(object_id)
+                if e is None:
+                    raise KeyError(f"object {object_id} not found")
+                e.last_access = _time.monotonic()
+                if not e.spilled:
+                    return (e.segment, e.size, e.kind, e.offset, e.host_id,
+                            e.payload_addr)
+            self._fault_in(object_id)
+        raise RuntimeError(
+            f"object {object_id} is thrashing between shm and spill; "
+            "raise raydp.tpu.object_store.shm_budget")
 
     def contains(self, object_id: str) -> bool:
         with self._lock:
@@ -320,17 +445,27 @@ class ObjectStoreServer:
             for oid in object_ids:
                 e = self._table.pop(oid, None)
                 if e is not None:
-                    freed.append(e)
+                    freed.append((oid, e))
         self._release_payloads(freed)
         return len(freed)
 
-    def _release_payloads(self, entries: List[_Entry]) -> None:
-        local = [(e.segment, e.offset) for e in entries
-                 if e.host_id == HEAD_HOST]
+    def _release_payloads(self, entries: List[Tuple[str, _Entry]]) -> None:
+        local = []
+        for oid, e in entries:
+            if e.host_id != HEAD_HOST:
+                continue
+            if e.spilled:
+                _remove_quiet(self._spill_path(oid))
+                with self._lock:
+                    self._spilled_bytes -= e.size
+            else:
+                local.append((e.segment, e.offset))
+                with self._lock:
+                    self._shm_bytes -= e.size
         if local:
             self.host.release(local)
         by_node: Dict[str, List[Tuple[str, int]]] = {}
-        for e in entries:
+        for _, e in entries:
             if e.host_id != HEAD_HOST:
                 by_node.setdefault(e.host_id, []).append((e.segment, e.offset))
         for host_id, items in by_node.items():
@@ -356,7 +491,7 @@ class ObjectStoreServer:
         freed = []
         with self._lock:
             for oid in [o for o, e in self._table.items() if e.owner == owner]:
-                freed.append(self._table.pop(oid))
+                freed.append((oid, self._table.pop(oid)))
         self._release_payloads(freed)
         return len(freed)
 
@@ -381,6 +516,10 @@ class ObjectStoreServer:
                 "total_bytes": sum(e.size for e in self._table.values()),
                 "owners": sorted({e.owner for e in self._table.values()}),
                 "hosts": sorted({e.host_id for e in self._table.values()}),
+                "shm_bytes": self._shm_bytes,
+                "spilled_bytes": self._spilled_bytes,
+                "spilled_objects": sum(1 for e in self._table.values()
+                                       if e.spilled),
             }
 
     def owned_by(self, owner: str) -> List[str]:
@@ -389,16 +528,31 @@ class ObjectStoreServer:
 
     def shutdown(self) -> None:
         with self._lock:
-            entries = list(self._table.values())
+            entries = list(self._table.items())
             self._table.clear()
         # node-hosted payloads: route their release to the owning agents
         # BEFORE the runtime tears the agents down (dedicated /dev/shm
         # segments on a node would otherwise outlive the session)
-        self._release_payloads([e for e in entries if e.host_id != HEAD_HOST])
-        for e in entries:
-            if e.host_id == HEAD_HOST and e.offset < 0:
+        self._release_payloads([(oid, e) for oid, e in entries
+                                if e.host_id != HEAD_HOST])
+        for oid, e in entries:
+            if e.host_id != HEAD_HOST:
+                continue
+            if e.spilled:
+                _remove_quiet(self._spill_path(oid))
+            elif e.offset < 0:
                 _unlink_segment(e.segment)
+        if self.spill_dir is not None:
+            import shutil
+            shutil.rmtree(self.spill_dir, ignore_errors=True)
         self.host.shutdown()
+
+
+def _remove_quiet(path: str) -> None:
+    try:
+        os.remove(path)
+    except OSError:
+        pass
 
 
 def _unlink_segment(segment: str) -> None:
@@ -459,10 +613,10 @@ class ObjectStoreClient:
         self.payload_addr = (payload_addr if payload_addr is not None
                              else os.environ.get(ENV_STORE_PAYLOAD_ADDR))
         self._peers: Dict[str, Any] = {}  # payload_addr -> RpcClient
-        # remote mode: this process has no usable shared memory at all; every
-        # payload read and write is head-mediated (compatibility slow path)
-        self.remote = (os.environ.get("RDT_STORE_REMOTE") == "1"
-                       if remote is None else bool(remote))
+        # remote mode (explicit constructor opt-in): this process has no
+        # usable shared memory at all; every payload read and write is
+        # head-mediated — the slow compatibility path for external clients
+        self.remote = bool(remote)
 
     # -- segment naming: session-scoped so shutdown can sweep leftovers -------
     def _segment_name(self, object_id: str) -> str:
@@ -596,6 +750,14 @@ class ObjectStoreClient:
 
     # -- read -----------------------------------------------------------------
     def _attach(self, object_id: str) -> Tuple[memoryview, str]:
+        try:
+            return self._attach_once(object_id)
+        except FileNotFoundError:
+            # the payload moved (spill eviction recycled the segment between
+            # our lookup and attach): one fresh lookup resolves the new home
+            return self._attach_once(object_id)
+
+    def _attach_once(self, object_id: str) -> Tuple[memoryview, str]:
         if self.remote:
             data, kind = self._server.fetch_payload(object_id)
             return memoryview(data), kind

@@ -46,8 +46,12 @@ def _is_complete(path: str) -> bool:
         return True
     if glob.glob(os.path.join(path, "manifest_*.json")):
         return False  # sharded write without the chief marker = torn
+    # _METADATA / _CHECKPOINT_METADATA: current orbax; bare "checkpoint"
+    # msgpack: older orbax aggregate format (pre-existing checkpoints must
+    # not read as torn, or resume silently restarts from scratch)
     return os.path.exists(os.path.join(path, "_METADATA")) \
-        or os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA"))
+        or os.path.exists(os.path.join(path, "_CHECKPOINT_METADATA")) \
+        or os.path.exists(os.path.join(path, "checkpoint"))
 
 
 def _step_dirs(ckpt_dir: str, complete_only: bool = True):
@@ -63,6 +67,55 @@ def _step_dirs(ckpt_dir: str, complete_only: bool = True):
             except ValueError:
                 pass
     return sorted(out)
+
+
+def _latest_agreed(ckpt_dir: str) -> Optional[Tuple[int, str]]:
+    """The ``(step, path)`` every rank will restore.
+
+    Single process: the locally-latest complete step. Multi-process gang:
+    ranks can disagree on which step is complete (lagging COMPLETE/manifest
+    visibility on networked storage), and ranks resuming different epochs
+    deadlock the first collective — so every rank takes the CHIEF's choice
+    (broadcast), and a rank that cannot see that step fails fast with a
+    shared-storage message instead of silently training from elsewhere."""
+    steps = _step_dirs(ckpt_dir)
+    import jax
+    if jax.process_count() <= 1:
+        return steps[-1] if steps else None
+    from jax.experimental import multihost_utils
+    local = steps[-1][0] if steps else -1
+    chief = int(multihost_utils.broadcast_one_to_all(np.int32(local)))
+    if chief < 0:
+        return None
+    for step, path in steps:
+        if step == chief:
+            return step, path
+    raise FileNotFoundError(
+        f"chief rank restores checkpoint step {chief} but rank "
+        f"{jax.process_index()} only sees steps {[s for s, _ in steps]} in "
+        f"{ckpt_dir!r}; multi-process gangs require checkpoint_dir on "
+        "shared storage visible to every rank")
+
+
+def ensure_shared_dir(ckpt_dir: str, tag: str) -> None:
+    """Gang-startup probe: the chief creates ``ckpt_dir``; every other rank
+    must see it after a barrier, else the gang runs on per-host paths and a
+    later save/resume deadlocks collectives. Fail fast with a shared-storage
+    message instead. No-op single-process."""
+    import jax
+    if jax.process_count() <= 1:
+        os.makedirs(ckpt_dir, exist_ok=True)
+        return
+    from jax.experimental import multihost_utils
+    if jax.process_index() == 0:
+        os.makedirs(ckpt_dir, exist_ok=True)
+    multihost_utils.sync_global_devices(tag)
+    if not os.path.isdir(ckpt_dir):
+        raise RuntimeError(
+            f"checkpoint_dir {ckpt_dir!r} is not visible on rank "
+            f"{jax.process_index()}'s machine: multi-process gangs need "
+            "shared storage for checkpoints — pass a checkpoint_dir on a "
+            "filesystem mounted on every rank's host")
 
 
 def _checkpointer():
@@ -341,10 +394,10 @@ def restore(ckpt_dir: str, template: Any) -> Optional[Tuple[Any, int]]:
     """Restore the latest checkpoint as HOST arrays into the structure of
     ``template``. Reads either format. Returns ``(state, step)`` or None.
     """
-    steps = _step_dirs(ckpt_dir)
-    if not steps:
+    latest = _latest_agreed(ckpt_dir)
+    if latest is None:
         return None
-    step, path = steps[-1]
+    step, path = latest
     if glob.glob(os.path.join(path, "manifest_*.json")):
         return _restore_sharded_host(path, template), step
     with _checkpointer() as ckptr:
@@ -380,10 +433,10 @@ def restore_placed(ckpt_dir: str, template: Any,
     correct in both single-process and gang topologies, for both formats.
     Sharded-format checkpoints restore shard-locally (each process reads only
     what its devices address). Returns ``(placed_state, step)`` or None."""
-    steps = _step_dirs(ckpt_dir)
-    if not steps:
+    latest = _latest_agreed(ckpt_dir)
+    if latest is None:
         return None
-    step, path = steps[-1]
+    step, path = latest
     if glob.glob(os.path.join(path, "manifest_*.json")):
         return _restore_sharded_placed(path, template, shardings), step
     with _checkpointer() as ckptr:
@@ -392,13 +445,15 @@ def restore_placed(ckpt_dir: str, template: Any,
 
 
 def restore_extra(ckpt_dir: str) -> Optional[dict]:
-    """The JSON sidecar of the latest checkpoint, or None."""
+    """The JSON sidecar of the latest checkpoint, or None. Gang-agreed like
+    the state restore: divergent epoch bookkeeping would desynchronize the
+    ranks' collective counts."""
     import json
 
-    steps = _step_dirs(ckpt_dir)
-    if not steps:
+    latest = _latest_agreed(ckpt_dir)
+    if latest is None:
         return None
-    path = os.path.join(steps[-1][1], "extra.json")
+    path = os.path.join(latest[1], "extra.json")
     if not os.path.exists(path):
         return None
     with open(path) as f:

@@ -428,6 +428,14 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         ``worker_env`` adds/overrides rank-process environment (a ``None``
         value removes the variable) — e.g. pinning ranks to CPU devices on a
         machine whose one TPU chip the driver owns.
+
+        **Shared storage requirement**: on a multi-machine gang,
+        ``checkpoint_dir`` must be a filesystem mounted on every rank's host
+        (the chief writes step dirs + COMPLETE markers all ranks must see,
+        and each rank writes its own parameter shards there). The default —
+        a driver-local temp dir — only works when all ranks share the
+        driver's machine; ranks that cannot see the directory fail fast at
+        startup with a clear error.
         """
         import copy
         import uuid as _uuid
@@ -490,6 +498,11 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
         columns = self._columns()
         mesh = self._build_mesh()  # jax.devices() is global under the gang
+        # sharded multi-writer checkpoints assume ONE filesystem: the chief
+        # mkdirs each step dir and its COMPLETE marker must be visible to
+        # every rank on resume — fail fast on per-host paths, don't deadlock
+        from raydp_tpu.train.checkpoint import ensure_shared_dir
+        ensure_shared_dir(ckpt_dir, "rdt_ckpt_dir_probe")
         from raydp_tpu.data.feed import process_local_batch_rows
         from raydp_tpu.parallel import batch_sharding
 

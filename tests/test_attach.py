@@ -1,0 +1,150 @@
+"""Attach/client mode: a standalone head shared by sequential drivers.
+
+Parity: the reference's test matrix runs in Ray-client mode against a head
+that outlives drivers (conftest.py:77-140; cluster-mode driver
+test_spark_cluster.py:113-134), and ownership-transferred data survives
+``stop_spark(cleanup_data=False)`` (test_from_spark.py:33-69). Here driver 1
+attaches to a standalone head process, converts a frame to a dataset owned by
+its master, detaches with ``cleanup_data=False``, and driver 2 — a separate
+process — attaches later and reads the same dataset out of the head's store.
+"""
+
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _start_head():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "raydp_tpu.runtime.head", "--listen",
+         "--port", "0"],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        start_new_session=True, text=True)
+    deadline = time.time() + 60.0
+    address = None
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("RDT_HEAD_READY "):
+            address = line.split()[1].strip()
+            break
+    if address is None:
+        proc.kill()
+        raise RuntimeError("standalone head never became ready")
+    return proc, address
+
+
+def _run_driver(body: str, address: str, payload_path: str):
+    script = textwrap.dedent(f"""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        ADDRESS = {address!r}
+        PAYLOAD = {payload_path!r}
+    """) + textwrap.dedent(body)
+    res = subprocess.run([sys.executable, "-c", script], env=_env(),
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, f"driver failed:\n{res.stdout}\n{res.stderr}"
+    return res
+
+
+def _kill(proc):
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        try:
+            proc.kill()
+        except ProcessLookupError:
+            pass
+
+
+def test_two_sequential_drivers_share_one_head(tmp_path):
+    head, address = _start_head()
+    payload_path = str(tmp_path / "payload.pkl")
+    try:
+        _run_driver("""
+            import pickle
+            import numpy as np
+            import pandas as pd
+            import raydp_tpu
+            from raydp_tpu.data.dataset import from_frame
+
+            s = raydp_tpu.init("driver1", num_executors=2, executor_cores=1,
+                               executor_memory="256MB", address=ADDRESS)
+            pdf = pd.DataFrame({"x": np.arange(1000, dtype=np.int64),
+                                "y": np.arange(1000) * 2.0})
+            df = s.createDataFrame(pdf, num_partitions=4)
+            ds = from_frame(df)          # blocks owned by driver1's master
+            with open(PAYLOAD, "wb") as f:
+                pickle.dump(ds.portable(), f)
+            # keep the master (and the data it owns) alive for driver 2
+            raydp_tpu.stop(cleanup_data=False)
+        """, address, payload_path)
+
+        _run_driver("""
+            import pickle
+            import numpy as np
+            import raydp_tpu
+            from raydp_tpu.data.dataset import DistributedDataset
+
+            s = raydp_tpu.init("driver2", num_executors=1, executor_cores=1,
+                               executor_memory="256MB", address=ADDRESS)
+            with open(PAYLOAD, "rb") as f:
+                payload = pickle.load(f)
+            ds = DistributedDataset.from_portable(payload)
+            assert ds.count() == 1000, ds.count()
+            table = ds.to_arrow()
+            x = np.sort(table.column("x").to_numpy())
+            assert (x == np.arange(1000)).all()
+            # driver1's master must still be resolvable by name
+            from raydp_tpu.runtime import get_runtime
+            assert get_runtime().get_actor("driver1_MASTER") is not None
+            raydp_tpu.stop()
+        """, address, payload_path)
+    finally:
+        _kill(head)
+
+
+def test_driver_crash_leaves_head_usable(tmp_path):
+    """A driver that exits without detaching must not poison the head: the
+    next driver attaches and works."""
+    head, address = _start_head()
+    payload_path = str(tmp_path / "unused.pkl")
+    try:
+        script = textwrap.dedent(f"""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import os
+            import raydp_tpu
+            s = raydp_tpu.init("crasher", num_executors=1, executor_cores=1,
+                               executor_memory="256MB", address={address!r})
+            s.range(100).count()
+            os._exit(1)  # die without stop()
+        """)
+        subprocess.run([sys.executable, "-c", script], env=_env(),
+                       capture_output=True, timeout=300)
+
+        _run_driver("""
+            import raydp_tpu
+            s = raydp_tpu.init("survivor", num_executors=1, executor_cores=1,
+                               executor_memory="256MB", address=ADDRESS)
+            assert s.range(500).count() == 500
+            raydp_tpu.stop()
+        """, address, payload_path)
+    finally:
+        _kill(head)

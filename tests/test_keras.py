@@ -92,3 +92,33 @@ def test_requires_model():
 
     with pytest.raises(ValueError, match="model"):
         KerasEstimator(feature_columns=["a"], label_column="y")
+
+
+def test_keras_fit_gang_matches_single_process(session, tmp_path):
+    """The gang path is a real peer of the Flax gang: 2 ranks under one
+    global jax.distributed mesh must reproduce the single-process losses
+    (same seed, same global batches) and leave a chief model.keras."""
+    from raydp_tpu.data.dataset import from_frame
+
+    df = _make_frame(session, n=1024)
+    train_df, eval_df = df.randomSplit([0.8, 0.2], seed=1)
+    train_ds, eval_ds = from_frame(train_df), from_frame(eval_df)
+
+    single = _estimator(num_epochs=3, shuffle=False,
+                        checkpoint_dir=str(tmp_path / "single"))
+    r1 = single.fit(train_ds, eval_ds)
+
+    gang = _estimator(num_epochs=3, shuffle=False,
+                      checkpoint_dir=str(tmp_path / "gang"))
+    r2 = gang.fit_gang(train_ds, eval_ds, num_workers=2, run_timeout=900.0)
+
+    assert len(r2.history) == len(r1.history) == 3
+    np.testing.assert_allclose([h["loss"] for h in r2.history],
+                               [h["loss"] for h in r1.history], rtol=2e-4)
+    np.testing.assert_allclose([h["val_loss"] for h in r2.history],
+                               [h["val_loss"] for h in r1.history], rtol=2e-4)
+    saved = os.path.join(r2.checkpoint_dir, "model.keras")
+    assert os.path.exists(saved)
+    model = gang.get_model()
+    preds = model.predict(np.array([[0.5, 0.5]], dtype=np.float32), verbose=0)
+    assert preds.shape == (1, 1)

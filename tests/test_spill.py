@@ -1,0 +1,108 @@
+"""Object-store eviction/spill: LRU to disk under an shm budget.
+
+Parity: plasma evicts/spills objects under memory pressure instead of failing
+or sprawling shared memory (SURVEY.md §2.3 item 11). Here sealed head-host
+objects past the configured shm budget spill to the session spill dir and
+fault back into shared memory transparently on read — writing 2× the budget
+and reading every byte back must work with bounded shm accounting.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from raydp_tpu import config as cfg
+from raydp_tpu.config import Config
+from raydp_tpu.runtime.head import RuntimeContext
+
+BUDGET = 2 << 20  # 2 MiB
+OBJ = 400_000     # ~0.4 MiB each
+
+
+@pytest.fixture
+def spill_rt():
+    rt = RuntimeContext(config=Config({
+        cfg.OBJECT_STORE_MEMORY_KEY: str(BUDGET),
+        cfg.SPILL_BUDGET_KEY: str(BUDGET),
+    }))
+    # immediate arena reclamation so spilled arena bytes free right away
+    rt.store_server.host.ARENA_FREE_GRACE_S = 0.0
+    yield rt
+    rt.shutdown()
+
+
+def test_write_2x_budget_read_all_back(spill_rt):
+    rt = spill_rt
+    client = rt.store_client
+    payloads = []
+    for i in range(10):  # 10 × 0.4 MiB = 2× the 2 MiB budget
+        data = np.random.RandomState(i).bytes(OBJ)
+        payloads.append((client.put_raw(data), data))
+
+    stats = rt.store_server.stats()
+    assert stats["spilled_objects"] > 0, "nothing spilled past the budget"
+    assert stats["shm_bytes"] <= BUDGET + OBJ, stats
+    assert stats["spilled_bytes"] + stats["shm_bytes"] == 10 * OBJ
+    spill_dir = rt.store_server.spill_dir
+    assert spill_dir and os.path.isdir(spill_dir)
+    assert len(os.listdir(spill_dir)) == stats["spilled_objects"]
+
+    # every object reads back byte-identical (transparent fault-in), and the
+    # budget still holds afterwards — reads must not inflate shm unboundedly
+    for ref, data in payloads:
+        assert client.get(ref) == data
+    after = rt.store_server.stats()
+    assert after["shm_bytes"] <= BUDGET + OBJ, after
+    assert after["spilled_bytes"] + after["shm_bytes"] == 10 * OBJ
+
+
+def test_free_removes_spill_files(spill_rt):
+    rt = spill_rt
+    client = rt.store_client
+    refs = [client.put_raw(np.random.RandomState(i).bytes(OBJ))
+            for i in range(10)]
+    spill_dir = rt.store_server.spill_dir
+    assert len(os.listdir(spill_dir)) > 0
+    client.free(refs)
+    assert rt.store_server.stats()["num_objects"] == 0
+    assert os.listdir(spill_dir) == []
+    assert rt.store_server.stats()["shm_bytes"] == 0
+    assert rt.store_server.stats()["spilled_bytes"] == 0
+
+
+def test_lru_order_spills_coldest_first(spill_rt):
+    rt = spill_rt
+    client = rt.store_client
+    refs = [client.put_raw(np.random.RandomState(i).bytes(OBJ))
+            for i in range(5)]  # fits: 2.0 MiB of 2 MiB budget... borderline
+    # touch ref 0 so it is the HOTTEST, then overflow the budget
+    client.get(refs[0])
+    overflow = [client.put_raw(np.random.RandomState(100 + i).bytes(OBJ))
+                for i in range(4)]
+    server = rt.store_server
+    # ref 0 was recently read: colder refs must have spilled before it
+    _, _, _, _, _, _ = server.lookup(refs[0].id)
+    with server._lock:
+        spilled = {oid for oid, e in server._table.items() if e.spilled}
+    cold_ids = {r.id for r in refs[1:]}
+    assert spilled & cold_ids, "no cold object spilled"
+    for ref in refs + overflow:
+        assert client.contains(ref)
+
+
+def test_spill_disabled_with_zero_budget():
+    rt = RuntimeContext(config=Config({
+        cfg.OBJECT_STORE_MEMORY_KEY: str(BUDGET),
+        cfg.SPILL_BUDGET_KEY: "0",
+    }))
+    try:
+        client = rt.store_client
+        refs = [client.put_raw(np.random.RandomState(i).bytes(OBJ))
+                for i in range(10)]
+        assert rt.store_server.spill_dir is None
+        assert rt.store_server.stats()["spilled_objects"] == 0
+        for i, ref in enumerate(refs):
+            assert client.get(ref) == np.random.RandomState(i).bytes(OBJ)
+    finally:
+        rt.shutdown()
