@@ -80,9 +80,40 @@ class ClientContext:
             default_owner=objstore.DRIVER_OWNER,
             remote=not same_machine)
         objstore.set_client(self.store_client)
+
+        # liveness: the head reaps a driver's still-bound actors when its
+        # heartbeats stop without a detach (Ray driver-lifetime semantics).
+        # The cadence comes from the head (reap window / 4) so a tight
+        # window cannot spuriously reap a live-but-slow-beating driver.
+        self._beat_interval = float(info.get("heartbeat_interval_s", 5.0))
+        self._stopped = threading.Event()
+        self._beat_thread = threading.Thread(
+            target=self._heartbeat, daemon=True, name="driver-heartbeat")
+        self._beat_thread.start()
         logger.info("attached to head at %s (session %s, %s)",
                     address, self.session_id[:12],
                     "same-machine" if same_machine else "remote")
+
+    def _heartbeat(self) -> None:
+        from raydp_tpu.runtime.rpc import ConnectionLost
+        while not self._stopped.wait(self._beat_interval):
+            try:
+                known = self.head.call("driver_heartbeat", self.driver_id,
+                                       timeout=10.0)
+            except ConnectionLost:
+                return  # head gone; this client is dead anyway
+            except Exception:
+                continue  # transient (e.g. busy dispatch pool): keep beating
+            if not known:
+                # the head already reaped this driver (network stall past the
+                # window, or a head restart): say so loudly once and stop —
+                # subsequent actor calls will fail, this is the cause
+                logger.error(
+                    "head no longer recognizes driver %s: this session was "
+                    "reaped (heartbeat gap exceeded the head's reap window); "
+                    "its actors are gone — re-attach to continue",
+                    self.driver_id)
+                return
 
     # ---- actors (the subset RuntimeContext exposes in-process) --------------
     def create_actor(
@@ -116,7 +147,7 @@ class ClientContext:
             bundle_index=bundle_index,
         )
         actor_id = self.head.call("create_actor", spec.__dict__, False,
-                                  timeout=60.0)
+                                  self.driver_id, timeout=60.0)
         handle = ActorHandle(actor_id, name, self.address)
         if block:
             handle.wait_ready()
@@ -136,8 +167,14 @@ class ClientContext:
 
     # ---- lifecycle ----------------------------------------------------------
     def shutdown(self) -> None:
-        """Detach. The head, its actors, and the store stay up for the next
-        driver — this is the whole point of attach mode."""
+        """Graceful detach: remaining actors are UNBOUND on the head (they
+        survive for the next driver); the head and store stay up — this is
+        the whole point of attach mode."""
+        self._stopped.set()
+        try:
+            self.head.call("detach_driver", self.driver_id, timeout=10.0)
+        except Exception:
+            pass
         try:
             self.store_client.close()
         except Exception:

@@ -99,6 +99,11 @@ class ActorRecord:
     deliberate_kill: bool = False
     ready: threading.Event = field(default_factory=threading.Event)
     resources_held: Dict[str, float] = field(default_factory=dict)
+    #: attach mode: the client driver this actor belongs to. A graceful
+    #: detach unbinds (actor survives for the next driver); a driver that
+    #: stops heartbeating without detaching gets its actors reaped — the
+    #: Ray semantics of non-detached actors dying with their driver.
+    driver_id: Optional[str] = None
 
 
 class HeadService:
@@ -195,11 +200,15 @@ class HeadService:
         return self._rt.add_ready_waiter(actor_id, timeout, mode="ready")
 
     def get_named_actor(self, name: str) -> Optional[str]:
-        return self._rt.names.get(name)
+        """Resolve a LIVE named actor (the in-process ``get_actor`` liveness
+        contract: dead actors don't resolve)."""
+        handle = self._rt.get_actor(name)
+        return handle.actor_id if handle is not None else None
 
-    def create_actor(self, spec_fields: Dict[str, Any], block: bool = False) -> str:
+    def create_actor(self, spec_fields: Dict[str, Any], block: bool = False,
+                     driver_id: Optional[str] = None) -> str:
         spec = ActorSpec(**spec_fields)
-        handle = self._rt.launch_actor(spec, block=block)
+        handle = self._rt.launch_actor(spec, block=block, driver_id=driver_id)
         return handle.actor_id
 
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
@@ -262,12 +271,25 @@ class HeadService:
     def attach_driver(self, driver_id: str) -> Dict[str, Any]:
         """A driver joins this (standalone) head as a client — parity with
         Ray-client mode in the reference's test matrix (conftest.py:77-140).
-        Nothing is driver-scoped here: names, actors, and stored objects all
-        belong to the head's session, so they survive driver exits."""
-        logger.info("driver %s attached", driver_id)
+        Names and stored objects belong to the head's session; actors the
+        driver creates are bound to it until it detaches (graceful detach
+        unbinds them to survive; a crashed driver's actors are reaped after
+        its heartbeats stop — the Ray driver-lifetime semantics)."""
+        self._rt.register_driver(driver_id)
         return {"session_id": self._rt.session_id,
                 "session_dir": self._rt.session_dir,
-                "driver_id": driver_id}
+                "driver_id": driver_id,
+                # clients derive their beat cadence from the head's reap
+                # window so a small window cannot spuriously reap live
+                # drivers that beat too slowly
+                "heartbeat_interval_s": max(
+                    1.0, self._rt.driver_reap_after_s / 4.0)}
+
+    def driver_heartbeat(self, driver_id: str) -> bool:
+        return self._rt.driver_heartbeat(driver_id)
+
+    def detach_driver(self, driver_id: str) -> bool:
+        return self._rt.detach_driver(driver_id)
 
 
 def _terminate(proc) -> None:
@@ -341,6 +363,10 @@ class RuntimeContext:
         self._lock = threading.RLock()
         self._waiters: List[tuple] = []  # (deadline, timeout, id, fut, mode)
         self._waiters_lock = threading.Lock()
+        #: attach-mode drivers: driver_id → last heartbeat monotonic time
+        self._drivers: Dict[str, float] = {}
+        self.driver_reap_after_s = float(
+            os.environ.get("RDT_DRIVER_REAP_S", "60"))
         self._stopped = threading.Event()
 
         self.service = HeadService(self)
@@ -421,7 +447,53 @@ class RuntimeContext:
         )
         return self.launch_actor(spec, block=block)
 
-    def launch_actor(self, spec: ActorSpec, block: bool = True) -> ActorHandle:
+    # ---- attach-mode driver lifetime ----------------------------------------
+    def register_driver(self, driver_id: str) -> None:
+        with self._lock:
+            self._drivers[driver_id] = time.monotonic()
+        logger.info("driver %s attached", driver_id)
+
+    def driver_heartbeat(self, driver_id: str) -> bool:
+        with self._lock:
+            if driver_id not in self._drivers:
+                return False
+            self._drivers[driver_id] = time.monotonic()
+            return True
+
+    def detach_driver(self, driver_id: str) -> bool:
+        """Graceful detach: the driver's remaining actors are UNBOUND — they
+        survive for the next driver (this is what carries the master of a
+        ``stop(cleanup_data=False)`` session across drivers)."""
+        with self._lock:
+            present = self._drivers.pop(driver_id, None) is not None
+            for rec in self.records.values():
+                if rec.driver_id == driver_id:
+                    rec.driver_id = None
+        if present:
+            logger.info("driver %s detached", driver_id)
+        return present
+
+    def _reap_dead_drivers(self) -> None:
+        """A driver that stopped heartbeating without detaching crashed: its
+        still-bound actors are reaped (Ray's non-detached-actor lifetime),
+        so a crashing client cannot leak sessions on a long-lived head."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [d for d, beat in self._drivers.items()
+                    if now - beat > self.driver_reap_after_s]
+            for d in dead:
+                del self._drivers[d]
+            victims = [rec.spec.actor_id for rec in self.records.values()
+                       if rec.driver_id in dead and rec.state != DEAD] \
+                if dead else []
+        for d in dead:
+            logger.warning("driver %s stopped heartbeating; reaping its "
+                           "actors", d)
+        for actor_id in victims:
+            self.kill_actor(actor_id, no_restart=True)
+
+    def launch_actor(self, spec: ActorSpec, block: bool = True,
+                     driver_id: Optional[str] = None) -> ActorHandle:
         with self._lock:
             if spec.name is not None and spec.name in self.names:
                 existing = self.records.get(self.names[spec.name])
@@ -445,7 +517,8 @@ class RuntimeContext:
                         f"cannot place actor {spec.name or spec.actor_id}: "
                         f"resources {spec.resources} not available")
                 held = dict(spec.resources)
-            rec = ActorRecord(spec=spec, node_id=node_id, resources_held=held)
+            rec = ActorRecord(spec=spec, node_id=node_id, resources_held=held,
+                              driver_id=driver_id)
             self.records[spec.actor_id] = rec
             if spec.name is not None:
                 self.names[spec.name] = spec.actor_id
@@ -582,6 +655,7 @@ class RuntimeContext:
             try:
                 self._supervise_once()
                 self._resolve_waiters()
+                self._reap_dead_drivers()
             except Exception:  # noqa: BLE001 - the supervisor must never die
                 logger.exception("supervisor tick failed; continuing")
             time.sleep(0.1)
@@ -632,15 +706,27 @@ class RuntimeContext:
                     logger.info("actor %s exited with code %s; dead",
                                 rec.spec.name or actor_id, code)
                     self.store_server.free_owned_by(self.owner_key(rec))
-        # pending RESTARTING actors with no process: retry placement
+        # pending RESTARTING actors with no process: retry placement — unless
+        # a deliberate kill arrived while the record had no process to
+        # terminate (e.g. a dead driver's reaped executor awaiting resources):
+        # resurrecting it would leak the actor forever
+        dead_now: List[ActorRecord] = []
         with self._lock:
             for rec in self.records.values():
                 if rec.state == RESTARTING and rec.process is None:
+                    if rec.deliberate_kill:
+                        rec.state = DEAD
+                        dead_now.append(rec)
+                        continue
                     node_id, held = self._replacement_node(rec)
                     if node_id is not None:
                         rec.node_id = node_id
                         rec.resources_held = held
                         self._spawn_supervised(rec)
+        for rec in dead_now:
+            logger.info("actor %s killed while awaiting restart; dead",
+                        rec.spec.name or rec.spec.actor_id)
+            self.store_server.free_owned_by(self.owner_key(rec))
 
     def _spawn_supervised(self, rec: ActorRecord) -> None:
         """Spawn from the supervisor thread: a failed spawn (e.g. the target

@@ -29,11 +29,13 @@ def _env():
     return env
 
 
-def _start_head():
+def _start_head(extra_env=None):
+    env = _env()
+    env.update(extra_env or {})
     proc = subprocess.Popen(
         [sys.executable, "-m", "raydp_tpu.runtime.head", "--listen",
          "--port", "0"],
-        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         start_new_session=True, text=True)
     deadline = time.time() + 60.0
     address = None
@@ -120,10 +122,12 @@ def test_two_sequential_drivers_share_one_head(tmp_path):
         _kill(head)
 
 
-def test_driver_crash_leaves_head_usable(tmp_path):
+def test_driver_crash_leaves_head_usable_and_reaps_actors(tmp_path):
     """A driver that exits without detaching must not poison the head: the
-    next driver attaches and works."""
-    head, address = _start_head()
+    next driver attaches and works, and the crasher's still-bound actors are
+    reaped once its heartbeats stop (Ray's non-detached-actor lifetime) —
+    a long-lived head must not accumulate leaked sessions."""
+    head, address = _start_head({"RDT_DRIVER_REAP_S": "8"})
     payload_path = str(tmp_path / "unused.pkl")
     try:
         script = textwrap.dedent(f"""
@@ -140,10 +144,22 @@ def test_driver_crash_leaves_head_usable(tmp_path):
                        capture_output=True, timeout=300)
 
         _run_driver("""
+            import time
             import raydp_tpu
             s = raydp_tpu.init("survivor", num_executors=1, executor_cores=1,
                                executor_memory="256MB", address=ADDRESS)
             assert s.range(500).count() == 500
+            # the crasher's session actors disappear after its heartbeats
+            # lapse (head runs with RDT_DRIVER_REAP_S=8)
+            from raydp_tpu.runtime import get_runtime
+            rt = get_runtime()
+            deadline = time.time() + 60.0
+            while time.time() < deadline:
+                if rt.get_actor("crasher_MASTER") is None:
+                    break
+                time.sleep(1.0)
+            assert rt.get_actor("crasher_MASTER") is None, \\
+                "crashed driver's master leaked"
             raydp_tpu.stop()
         """, address, payload_path)
     finally:
