@@ -359,11 +359,12 @@ def _lm_mode_run(mode: str, T: int) -> dict:
     from raydp_tpu.models.transformer import lm_loss_fused
 
     dim = int(os.environ.get("BENCH_LM_DIM", "512"))
-    if dim % 64:
-        raise SystemExit("BENCH_LM_DIM must be a multiple of 64 "
-                         "(64-wide heads)")
+    head_dim = int(os.environ.get("BENCH_LM_HEAD_DIM", "64"))
+    if dim % head_dim:
+        raise SystemExit("BENCH_LM_DIM must be a multiple of "
+                         "BENCH_LM_HEAD_DIM")
     layers = int(os.environ.get("BENCH_LM_LAYERS", "4"))
-    heads, vocab = dim // 64, 32768
+    heads, vocab = dim // head_dim, 32768
     B = int(os.environ.get("BENCH_LM_BATCH", "2"))
     steps = int(os.environ.get("BENCH_LM_STEPS", "8"))
     rng = np.random.RandomState(0)

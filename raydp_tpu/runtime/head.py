@@ -155,9 +155,11 @@ class HeadService:
     def store_locations(self, *a):
         return self._rt.store_server.locations(*a)
 
-    def register_store_host(self, node_id: str, arena_segment):
+    def register_store_host(self, node_id: str, arena_segment,
+                            shm_budget=None):
         """A node agent announces its machine-local payload plane."""
-        return self._rt.register_store_host(node_id, arena_segment)
+        return self._rt.register_store_host(node_id, arena_segment,
+                                            shm_budget)
 
     # ---- actor lifecycle ----------------------------------------------------
     def fetch_actor_spec(self, actor_id: str) -> Dict[str, Any]:
@@ -360,6 +362,9 @@ class RuntimeContext:
         # head-mediated-fetched through the owning node's agent RPC
         self.store_server.node_release = self._node_store_release
         self.store_server.node_fetch = self._node_store_fetch
+        self.store_server.node_spill = self._node_store_spill
+        self.store_server.node_fault_in = self._node_store_fault_in
+        self.store_server.node_remove_spill = self._node_store_remove_spill
         self._lock = threading.RLock()
         self._waiters: List[tuple] = []  # (deadline, timeout, id, fut, mode)
         self._waiters_lock = threading.Lock()
@@ -494,6 +499,15 @@ class RuntimeContext:
 
     def launch_actor(self, spec: ActorSpec, block: bool = True,
                      driver_id: Optional[str] = None) -> ActorHandle:
+        if driver_id is not None:
+            # a client creating actors is self-evidently alive: re-register
+            # it if a heartbeat stall already reaped it, so its new actors
+            # stay reapable instead of leaking bound to an unknown driver
+            with self._lock:
+                if driver_id not in self._drivers:
+                    self._drivers[driver_id] = time.monotonic()
+                    logger.info("driver %s re-registered via create_actor",
+                                driver_id)
         with self._lock:
             if spec.name is not None and spec.name in self.names:
                 existing = self.records.get(self.names[spec.name])
@@ -794,9 +808,11 @@ class RuntimeContext:
                 "store_mode": "isolated" if isolated else "shared"}
 
     def register_store_host(self, node_id: str,
-                            arena_segment: Optional[str]) -> bool:
+                            arena_segment: Optional[str],
+                            shm_budget: Optional[int] = None) -> bool:
         with self._lock:
             self.store_hosts[node_id] = arena_segment
+        self.store_server.register_node_budget(node_id, shm_budget)
         return True
 
     def store_host_of_node(self, node_id: Optional[str]) -> str:
@@ -807,10 +823,11 @@ class RuntimeContext:
             return node_id
         return objstore.HEAD_HOST
 
-    def _node_store_release(self, host_id: str, items) -> None:
+    def _node_store_release(self, host_id: str, items,
+                            defer_segments: bool = False) -> None:
         agent = self.node_agents.get(host_id)
         if agent is not None:
-            agent.call("store_release", items, timeout=30.0)
+            agent.call("store_release", items, defer_segments, timeout=30.0)
 
     def _node_store_fetch(self, host_id: str, segment: str, offset: int,
                           size: int) -> bytes:
@@ -818,6 +835,27 @@ class RuntimeContext:
         if agent is None:
             raise KeyError(f"node {host_id} is gone; payload unreadable")
         return agent.call("store_fetch", segment, offset, size, timeout=60.0)
+
+    def _node_store_spill(self, host_id: str, object_id: str, segment: str,
+                          offset: int, size: int) -> bool:
+        agent = self.node_agents.get(host_id)
+        if agent is None:
+            raise KeyError(f"node {host_id} is gone")
+        return agent.call("store_spill", object_id, segment, offset, size,
+                          timeout=120.0)
+
+    def _node_store_fault_in(self, host_id: str, object_id: str,
+                             seg_name: str):
+        agent = self.node_agents.get(host_id)
+        if agent is None:
+            raise KeyError(f"node {host_id} is gone")
+        return agent.call("store_fault_in", object_id, seg_name,
+                          timeout=120.0)
+
+    def _node_store_remove_spill(self, host_id: str, object_id: str) -> None:
+        agent = self.node_agents.get(host_id)
+        if agent is not None:
+            agent.call("store_remove_spill", object_id, timeout=30.0)
 
     def _agent_lost(self, node_id: str) -> None:
         agent = self.node_agents.pop(node_id, None)

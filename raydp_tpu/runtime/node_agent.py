@@ -87,9 +87,10 @@ class NodeAgentService:
         the one-hop node-to-node transfer of the distributed data plane."""
         return self._agent.payload_host.fetch(segment, offset, size)
 
-    def store_release(self, items) -> int:
+    def store_release(self, items, defer_segments: bool = False) -> int:
         return self._agent.payload_host.release(
-            [(seg, int(off)) for seg, off in items])
+            [(seg, int(off)) for seg, off in items],
+            defer_segments=defer_segments)
 
     def store_reap(self) -> bool:
         return self._agent.payload_host.reap()
@@ -99,6 +100,45 @@ class NodeAgentService:
 
     def store_arena_stats(self):
         return self._agent.payload_host.arena_stats()
+
+    # ---- node-local eviction/spill (head-directed) --------------------------
+    def store_spill(self, object_id: str, segment: str, offset: int,
+                    size: int) -> bool:
+        """Copy a payload hosted here to this machine's spill dir (the head
+        owns the table and the LRU decision; the bytes never leave the node).
+        The shm is NOT released here — the head releases it exactly once,
+        after confirming the table entry survived the write (a concurrent
+        free() would otherwise double-release the same arena offset)."""
+        agent = self._agent
+        data = agent.payload_host.fetch(segment, int(offset), int(size))
+        os.makedirs(agent.spill_dir, exist_ok=True)
+        path = os.path.join(agent.spill_dir, object_id)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        return True
+
+    def store_fault_in(self, object_id: str, seg_name: str):
+        """Bring a spilled payload back into this machine's shm; returns the
+        new ``(segment, offset)``."""
+        agent = self._agent
+        path = os.path.join(agent.spill_dir, object_id)
+        with open(path, "rb") as f:
+            data = f.read()
+        segment, offset = agent.payload_host.write(data, seg_name)
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return segment, offset
+
+    def store_remove_spill(self, object_id: str) -> bool:
+        try:
+            os.remove(os.path.join(self._agent.spill_dir, object_id))
+            return True
+        except OSError:
+            return False
 
 
 class NodeAgent:
@@ -133,10 +173,17 @@ class NodeAgent:
         self.store_isolated = reply.get("store_mode") == "isolated"
         self.payload_host = PayloadHost(
             self._create_arena() if self.store_isolated else None)
+        self.spill_dir = os.path.join(self.session_dir,
+                                      f"spill-{self.node_id}")
         if self.store_isolated:
             info = self.payload_host.arena_info()
+            # this machine's shm budget: objects past it LRU-spill to the
+            # node's spill dir under the head's direction
+            budget = int(os.environ.get(
+                "RDT_NODE_SHM_BUDGET",
+                info["size"] if info else (1 << 30)))
             self.head.call("register_store_host", self.node_id,
-                           info["segment"] if info else None)
+                           info["segment"] if info else None, budget)
         logger.info("node agent %s registered with %s (resources=%s, store=%s)",
                     self.node_id, head_url, resources,
                     "isolated" if self.store_isolated else "shared")
@@ -261,6 +308,8 @@ class NodeAgent:
             self.payload_host.shutdown()
         except Exception:
             pass
+        import shutil
+        shutil.rmtree(self.spill_dir, ignore_errors=True)
         logger.info("node agent %s stopped", self.node_id)
 
 

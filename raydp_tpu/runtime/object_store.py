@@ -250,6 +250,13 @@ class ObjectStoreServer:
         # callbacks wired by RuntimeContext for payloads on agent machines
         self.node_release = None  # (host_id, [(segment, offset)]) -> None
         self.node_fetch = None    # (host_id, segment, offset, size) -> bytes
+        self.node_spill = None    # (host_id, oid, segment, offset, size)
+        self.node_fault_in = None  # (host_id, oid, seg_name) -> (seg, off)
+        self.node_remove_spill = None  # (host_id, oid) -> None
+        # per-node shm accounting (the head owns the table and the LRU
+        # decision; the payload IO happens on the owning node)
+        self._host_bytes: Dict[str, int] = {}
+        self._host_budgets: Dict[str, int] = {}
         # eviction/spill (plasma parity): sealed head-host objects LRU-spill
         # to disk once their shm footprint exceeds the budget; lookups fault
         # them back in transparently. Disabled when spill_dir is None.
@@ -257,7 +264,7 @@ class ObjectStoreServer:
         self.shm_budget = shm_budget
         self._shm_bytes = 0        # unspilled head-host payload bytes
         self._spilled_bytes = 0
-        self._spill_io_lock = threading.Lock()  # one spill/fault-in at a time
+        self._spill_locks: Dict[str, threading.Lock] = {}
         self._fault_gen = 0        # fault-in segments get fresh names (the
         #                            old name may still be alive under grace)
 
@@ -284,95 +291,180 @@ class ObjectStoreServer:
                                             last_access=_time.monotonic())
             if host_id == HEAD_HOST:
                 self._shm_bytes += size
+            else:
+                self._host_bytes[host_id] = \
+                    self._host_bytes.get(host_id, 0) + size
         self.host.reap()
-        self._maybe_spill(exclude=object_id)
+        self._maybe_spill(host_id, exclude=object_id)
 
-    # -- eviction/spill --------------------------------------------------------
+    # -- eviction/spill (one implementation; per-host backends) ---------------
     def _spill_path(self, object_id: str) -> str:
         return os.path.join(self.spill_dir, object_id)
 
-    def _maybe_spill(self, exclude: Optional[str] = None) -> None:
-        """LRU-spill sealed head-host objects until shm use fits the budget.
-        Arena bytes are released on the usual view-grace deferral and
-        dedicated segments unlink (mapped readers keep their views), so a
-        borrowed zero-copy view never sees recycled bytes. Parity: plasma's
-        eviction/spill under memory pressure."""
-        if self.spill_dir is None or not self.shm_budget:
+    def register_node_budget(self, host_id: str, budget: Optional[int]) -> None:
+        if budget:
+            self._host_budgets[host_id] = int(budget)
+
+    def _budget_of(self, host_id: str) -> Optional[int]:
+        if host_id == HEAD_HOST:
+            return self.shm_budget if self.spill_dir is not None else None
+        return self._host_budgets.get(host_id) \
+            if self.node_spill is not None else None
+
+    def _shm_used(self, host_id: str) -> int:
+        return self._shm_bytes if host_id == HEAD_HOST \
+            else self._host_bytes.get(host_id, 0)
+
+    def _adjust_shm(self, host_id: str, delta: int) -> None:
+        """Caller holds self._lock."""
+        if host_id == HEAD_HOST:
+            self._shm_bytes += delta
+        else:
+            self._host_bytes[host_id] = \
+                self._host_bytes.get(host_id, 0) + delta
+
+    def _spill_lock(self, host_id: str) -> threading.Lock:
+        """One spill/fault-in at a time PER HOST: a slow or dead node must
+        not stall the head plane (or other nodes) behind its 120s RPCs."""
+        with self._lock:
+            lock = self._spill_locks.get(host_id)
+            if lock is None:
+                lock = self._spill_locks[host_id] = threading.Lock()
+            return lock
+
+    def _backend(self, host_id: str):
+        """(write_spill, release_shm, fault_read, remove_spill) for a host —
+        head-local file/shm IO, or the owning node's agent RPCs. Everything
+        above this seam (LRU choice, survive re-check, counters) is shared."""
+        if host_id == HEAD_HOST:
+            def write_spill(oid, segment, offset, size):
+                data = self.host.fetch(segment, offset, size)
+                os.makedirs(self.spill_dir, exist_ok=True)
+                tmp = self._spill_path(oid) + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, self._spill_path(oid))
+
+            def release_shm(segment, offset):
+                self.host.release([(segment, offset)], defer_segments=True)
+
+            def fault_read(oid, seg_name):
+                with open(self._spill_path(oid), "rb") as f:
+                    data = f.read()
+                segment, offset = self.host.write(data, seg_name)
+                _remove_quiet(self._spill_path(oid))
+                return segment, offset
+
+            def remove_spill(oid):
+                _remove_quiet(self._spill_path(oid))
+        else:
+            def write_spill(oid, segment, offset, size):
+                self.node_spill(host_id, oid, segment, offset, size)
+
+            def release_shm(segment, offset):
+                self.node_release(host_id, [(segment, offset)],
+                                  defer_segments=True)
+
+            def fault_read(oid, seg_name):
+                segment, offset = self.node_fault_in(host_id, oid, seg_name)
+                return segment, int(offset)
+
+            def remove_spill(oid):
+                if self.node_remove_spill is not None:
+                    try:
+                        self.node_remove_spill(host_id, oid)
+                    except Exception:
+                        pass
+        return write_spill, release_shm, fault_read, remove_spill
+
+    def _maybe_spill(self, host_id: str = HEAD_HOST,
+                     exclude: Optional[str] = None) -> None:
+        """LRU-spill sealed objects on ``host_id`` until its shm use fits its
+        budget. Shm bytes are released on the view-grace deferral (segments
+        included), so borrowed zero-copy views and lookup-then-attach readers
+        never see recycled bytes. Parity: plasma eviction/spill."""
+        budget = self._budget_of(host_id)
+        if not budget:
             return
         while True:
             with self._lock:
-                if self._shm_bytes <= self.shm_budget:
+                if self._shm_used(host_id) <= budget:
                     return
                 victims = sorted(
                     ((e.last_access, oid) for oid, e in self._table.items()
-                     if e.host_id == HEAD_HOST and not e.spilled
+                     if e.host_id == host_id and not e.spilled
                      and e.size > 0 and oid != exclude))
                 if not victims:
                     return
                 victim = victims[0][1]
-            if not self._spill_one(victim):
+            if not self._spill_one(host_id, victim):
                 return
 
-    def _spill_one(self, object_id: str) -> bool:
-        with self._spill_io_lock:
+    def _spill_one(self, host_id: str, object_id: str) -> bool:
+        write_spill, release_shm, _, remove_spill = self._backend(host_id)
+        released = None
+        with self._spill_lock(host_id):
             with self._lock:
                 e = self._table.get(object_id)
-                if e is None or e.spilled or e.host_id != HEAD_HOST:
+                if e is None or e.spilled or e.host_id != host_id:
                     return False
                 segment, offset, size = e.segment, e.offset, e.size
             try:
-                data = self.host.fetch(segment, offset, size)
-                os.makedirs(self.spill_dir, exist_ok=True)
-                tmp = self._spill_path(object_id) + ".tmp"
-                with open(tmp, "wb") as f:
-                    f.write(data)
-                os.replace(tmp, self._spill_path(object_id))
-            except Exception as exc:  # pragma: no cover - disk trouble
-                logger.warning("spill of %s failed: %s", object_id, exc)
+                write_spill(object_id, segment, offset, size)
+            except Exception as exc:
+                logger.warning("spill of %s on %s failed: %s",
+                               object_id, host_id, exc)
                 return False
             with self._lock:
                 e = self._table.get(object_id)
-                if e is None:  # freed while we were writing: drop the file
-                    _remove_quiet(self._spill_path(object_id))
+                if e is None:
+                    # freed while we were writing: free() already released
+                    # the shm — drop only the now-orphaned spill file (the
+                    # shm must NOT be released twice, an offset double-free
+                    # would reclaim someone else's live bytes)
+                    remove_spill(object_id)
                     return True
                 e.spilled = True
                 e.segment, e.offset = "", -1
-                self._shm_bytes -= size
+                self._adjust_shm(host_id, -size)
                 self._spilled_bytes += size
-            # defer the segment unlink too: a reader between lookup and
-            # attach must still find the name during the grace window
-            self.host.release([(segment, offset)], defer_segments=True)
-            return True
+                released = (segment, offset)
+        # exactly-once, only after the entry survived the write
+        try:
+            release_shm(*released)
+        except Exception as exc:
+            logger.warning("post-spill release on %s failed: %s",
+                           host_id, exc)
+        return True
 
-    def _fault_in(self, object_id: str) -> None:
+    def _fault_in(self, host_id: str, object_id: str) -> None:
         """Bring a spilled payload back into shm (transparent on lookup)."""
         import time as _time
-        with self._spill_io_lock:
+        _, release_shm, fault_read, _ = self._backend(host_id)
+        with self._spill_lock(host_id):
             with self._lock:
                 e = self._table.get(object_id)
                 if e is None or not e.spilled:
                     return  # raced with another fault-in or a free
                 size = e.size
-            path = self._spill_path(object_id)
-            with open(path, "rb") as f:
-                data = f.read()
             self._fault_gen += 1
             seg_name = (f"rdt{self.session_id[:8]}_{object_id[:20]}"
                         f"g{self._fault_gen}")
-            segment, offset = self.host.write(data, seg_name)
+            segment, offset = fault_read(object_id, seg_name)
             with self._lock:
                 e = self._table.get(object_id)
-                if e is None:  # freed mid-fault-in
-                    self.host.release([(segment, offset)])
-                    _remove_quiet(path)
+                if e is None:  # freed mid-fault-in: drop the fresh shm
+                    try:
+                        release_shm(segment, offset)
+                    except Exception:
+                        pass
                     return
                 e.segment, e.offset = segment, offset
                 e.spilled = False
                 e.last_access = _time.monotonic()
-                self._shm_bytes += size
+                self._adjust_shm(host_id, size)
                 self._spilled_bytes -= size
-            _remove_quiet(path)
-        self._maybe_spill(exclude=object_id)
+        self._maybe_spill(host_id, exclude=object_id)
 
     # -- head-mediated payload path (clients with NO shared memory at all) -----
     def fetch_payload(self, object_id: str) -> Tuple[bytes, str]:
@@ -417,7 +509,8 @@ class ObjectStoreServer:
                 if not e.spilled:
                     return (e.segment, e.size, e.kind, e.offset, e.host_id,
                             e.payload_addr)
-            self._fault_in(object_id)
+                host_id = e.host_id
+            self._fault_in(host_id, object_id)
         raise RuntimeError(
             f"object {object_id} is thrashing between shm and spill; "
             "raise raydp.tpu.object_store.shm_budget")
@@ -465,8 +558,21 @@ class ObjectStoreServer:
         if local:
             self.host.release(local)
         by_node: Dict[str, List[Tuple[str, int]]] = {}
-        for _, e in entries:
-            if e.host_id != HEAD_HOST:
+        for oid, e in entries:
+            if e.host_id == HEAD_HOST:
+                continue
+            if e.spilled:
+                with self._lock:
+                    self._spilled_bytes -= e.size
+                if self.node_remove_spill is not None:
+                    try:
+                        self.node_remove_spill(e.host_id, oid)
+                    except Exception:
+                        pass
+            else:
+                with self._lock:
+                    self._host_bytes[e.host_id] = \
+                        self._host_bytes.get(e.host_id, 0) - e.size
                 by_node.setdefault(e.host_id, []).append((e.segment, e.offset))
         for host_id, items in by_node.items():
             if self.node_release is None:
@@ -502,8 +608,12 @@ class ObjectStoreServer:
         with self._lock:
             for oid in [o for o, e in self._table.items()
                         if e.host_id == host_id]:
+                if self._table[oid].spilled:
+                    self._spilled_bytes -= self._table[oid].size
                 del self._table[oid]
                 dropped += 1
+            self._host_bytes.pop(host_id, None)
+            self._host_budgets.pop(host_id, None)
         if dropped:
             logger.warning("purged %d objects hosted on dead node %s",
                            dropped, host_id)

@@ -221,3 +221,49 @@ def test_shared_machine_agent_keeps_zero_copy_plane(runtime):
         assert rt.store_client.get(ref).num_rows == 64
     finally:
         _kill(agent)
+
+
+def test_node_hosted_spill_under_budget(runtime):
+    """Node-hosted payloads past the node's shm budget LRU-spill to the
+    NODE's spill dir (head directs, the bytes never leave the machine) and
+    fault back in transparently when read — plasma eviction parity for the
+    distributed plane, not just the head host."""
+    rt = runtime
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["RDT_STORE_ISOLATED"] = "1"
+    env["RDT_ARENA_FREE_GRACE_S"] = "0"
+    env["RDT_NODE_ARENA_SIZE"] = str(2 << 20)   # 2 MiB node arena
+    env["RDT_NODE_SHM_BUDGET"] = str(2 << 20)   # = budget
+    agent = subprocess.Popen(
+        [sys.executable, "-m", "raydp_tpu.runtime.node_agent",
+         "--head", rt.server.url, "--cpus", "4.0"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        start_new_session=True)
+    try:
+        node_id = _wait_store_host(rt)
+        h = rt.create_actor(Writer, name="w-spill", node_id=node_id,
+                            resources={"CPU": 1.0})
+        # ~50k int64 rows ≈ 0.4 MiB/object; 10 objects = 2× the 2 MiB budget
+        refs = [h.put_table(50_000) for _ in range(10)]
+        stats = rt.store_server.stats()
+        assert stats["spilled_objects"] > 0, "nothing spilled on the node"
+        with rt.store_server._lock:
+            node_bytes = rt.store_server._host_bytes.get(node_id, 0)
+        assert node_bytes <= (2 << 20) + 500_000, node_bytes
+
+        # every object reads back (driver side: direct node fetch after the
+        # head faults the payload back onto the node)
+        for ref in refs:
+            assert rt.store_client.get(ref).num_rows == 50_000
+        # and the budget still holds after the reads
+        with rt.store_server._lock:
+            node_bytes = rt.store_server._host_bytes.get(node_id, 0)
+        assert node_bytes <= (2 << 20) + 500_000, node_bytes
+
+        rt.store_client.free(refs)
+        after = rt.store_server.stats()
+        assert after["spilled_bytes"] == 0
+    finally:
+        _kill(agent)
