@@ -852,10 +852,10 @@ class RuntimeContext:
         return agent.call("store_fault_in", object_id, seg_name,
                           timeout=120.0)
 
-    def _node_store_remove_spill(self, host_id: str, object_id: str) -> None:
+    def _node_store_remove_spill(self, host_id: str, object_ids) -> None:
         agent = self.node_agents.get(host_id)
         if agent is not None:
-            agent.call("store_remove_spill", object_id, timeout=30.0)
+            agent.call("store_remove_spill", list(object_ids), timeout=30.0)
 
     def _agent_lost(self, node_id: str) -> None:
         agent = self.node_agents.pop(node_id, None)

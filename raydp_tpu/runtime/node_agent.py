@@ -121,24 +121,24 @@ class NodeAgentService:
 
     def store_fault_in(self, object_id: str, seg_name: str):
         """Bring a spilled payload back into this machine's shm; returns the
-        new ``(segment, offset)``."""
+        new ``(segment, offset)``. The spill file is kept — the head removes
+        it only after its table commits the new location (a lost reply must
+        leave the object recoverable)."""
         agent = self._agent
         path = os.path.join(agent.spill_dir, object_id)
         with open(path, "rb") as f:
             data = f.read()
-        segment, offset = agent.payload_host.write(data, seg_name)
-        try:
-            os.remove(path)
-        except OSError:
-            pass
-        return segment, offset
+        return agent.payload_host.write(data, seg_name)
 
-    def store_remove_spill(self, object_id: str) -> bool:
-        try:
-            os.remove(os.path.join(self._agent.spill_dir, object_id))
-            return True
-        except OSError:
-            return False
+    def store_remove_spill(self, object_ids) -> int:
+        n = 0
+        for oid in object_ids:
+            try:
+                os.remove(os.path.join(self._agent.spill_dir, oid))
+                n += 1
+            except OSError:
+                pass
+        return n
 
 
 class NodeAgent:

@@ -252,7 +252,7 @@ class ObjectStoreServer:
         self.node_fetch = None    # (host_id, segment, offset, size) -> bytes
         self.node_spill = None    # (host_id, oid, segment, offset, size)
         self.node_fault_in = None  # (host_id, oid, seg_name) -> (seg, off)
-        self.node_remove_spill = None  # (host_id, oid) -> None
+        self.node_remove_spill = None  # (host_id, [oids]) -> None
         # per-node shm accounting (the head owns the table and the LRU
         # decision; the payload IO happens on the owning node)
         self._host_bytes: Dict[str, int] = {}
@@ -349,11 +349,11 @@ class ObjectStoreServer:
                 self.host.release([(segment, offset)], defer_segments=True)
 
             def fault_read(oid, seg_name):
+                # the spill file is NOT deleted here: removal is directed by
+                # the caller only after the table committed the new location
                 with open(self._spill_path(oid), "rb") as f:
                     data = f.read()
-                segment, offset = self.host.write(data, seg_name)
-                _remove_quiet(self._spill_path(oid))
-                return segment, offset
+                return self.host.write(data, seg_name)
 
             def remove_spill(oid):
                 _remove_quiet(self._spill_path(oid))
@@ -372,7 +372,7 @@ class ObjectStoreServer:
             def remove_spill(oid):
                 if self.node_remove_spill is not None:
                     try:
-                        self.node_remove_spill(host_id, oid)
+                        self.node_remove_spill(host_id, [oid])
                     except Exception:
                         pass
         return write_spill, release_shm, fault_read, remove_spill
@@ -402,7 +402,7 @@ class ObjectStoreServer:
 
     def _spill_one(self, host_id: str, object_id: str) -> bool:
         write_spill, release_shm, _, remove_spill = self._backend(host_id)
-        released = None
+        survived = False
         with self._spill_lock(host_id):
             with self._lock:
                 e = self._table.get(object_id)
@@ -417,30 +417,39 @@ class ObjectStoreServer:
                 return False
             with self._lock:
                 e = self._table.get(object_id)
-                if e is None:
-                    # freed while we were writing: free() already released
-                    # the shm — drop only the now-orphaned spill file (the
-                    # shm must NOT be released twice, an offset double-free
-                    # would reclaim someone else's live bytes)
-                    remove_spill(object_id)
-                    return True
-                e.spilled = True
-                e.segment, e.offset = "", -1
-                self._adjust_shm(host_id, -size)
-                self._spilled_bytes += size
-                released = (segment, offset)
+                if e is not None:
+                    e.spilled = True
+                    e.segment, e.offset = "", -1
+                    self._adjust_shm(host_id, -size)
+                    self._spilled_bytes += size
+                    survived = True
+        # backend IO OUTSIDE the table lock (for node hosts these are RPCs
+        # and must not stall every seal/lookup/free behind them):
+        if not survived:
+            # freed while we were writing: free() already released the shm —
+            # only the now-orphaned spill file needs to go (the shm must NOT
+            # be released twice; an offset double-free would reclaim someone
+            # else's live bytes)
+            remove_spill(object_id)
+            return True
         # exactly-once, only after the entry survived the write
         try:
-            release_shm(*released)
+            release_shm(segment, offset)
         except Exception as exc:
             logger.warning("post-spill release on %s failed: %s",
                            host_id, exc)
         return True
 
     def _fault_in(self, host_id: str, object_id: str) -> None:
-        """Bring a spilled payload back into shm (transparent on lookup)."""
+        """Bring a spilled payload back into shm (transparent on lookup).
+
+        The spill file is removed only AFTER the table commits the new shm
+        location: a fault-in whose result is lost (dropped RPC reply, slow
+        node exceeding the call timeout) leaves the file in place, so the
+        next lookup simply retries instead of losing the object forever."""
         import time as _time
-        _, release_shm, fault_read, _ = self._backend(host_id)
+        _, release_shm, fault_read, remove_spill = self._backend(host_id)
+        committed = False
         with self._spill_lock(host_id):
             with self._lock:
                 e = self._table.get(object_id)
@@ -458,12 +467,15 @@ class ObjectStoreServer:
                         release_shm(segment, offset)
                     except Exception:
                         pass
-                    return
+                    return  # free() already removed the spill file
                 e.segment, e.offset = segment, offset
                 e.spilled = False
                 e.last_access = _time.monotonic()
                 self._adjust_shm(host_id, size)
                 self._spilled_bytes -= size
+                committed = True
+        if committed:
+            remove_spill(object_id)
         self._maybe_spill(host_id, exclude=object_id)
 
     # -- head-mediated payload path (clients with NO shared memory at all) -----
@@ -558,22 +570,27 @@ class ObjectStoreServer:
         if local:
             self.host.release(local)
         by_node: Dict[str, List[Tuple[str, int]]] = {}
+        spill_removals: Dict[str, List[str]] = {}
         for oid, e in entries:
             if e.host_id == HEAD_HOST:
                 continue
             if e.spilled:
                 with self._lock:
                     self._spilled_bytes -= e.size
-                if self.node_remove_spill is not None:
-                    try:
-                        self.node_remove_spill(e.host_id, oid)
-                    except Exception:
-                        pass
+                spill_removals.setdefault(e.host_id, []).append(oid)
             else:
                 with self._lock:
                     self._host_bytes[e.host_id] = \
                         self._host_bytes.get(e.host_id, 0) - e.size
                 by_node.setdefault(e.host_id, []).append((e.segment, e.offset))
+        for host_id, oids in spill_removals.items():
+            # one batched RPC per host, like the shm-release path below
+            if self.node_remove_spill is None:
+                continue
+            try:
+                self.node_remove_spill(host_id, oids)
+            except Exception:
+                pass
         for host_id, items in by_node.items():
             if self.node_release is None:
                 continue
