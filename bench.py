@@ -4,26 +4,37 @@ Prints ONE JSON line (primary metric = the BASELINE.json headline config:
 NYCTaxi ETL→train samples/sec/chip) with the other configs under ``extra``:
 
 - ``nyctaxi``      CSV → distributed feature ETL → pjit MLP (FlaxEstimator)
-- ``dlrm``         Criteo-format TSV → dictionary/log preprocess → DLRM
-                   (reference examples/pytorch_dlrm.ipynb workload shape)
+- ``gbdt``         XLA histogram-GBDT on the NYCTaxi shape (xgboost parity)
 - ``keras``        the TFEstimator-parity path (Keras 3 on JAX)
+- ``gang``         1/2/4-rank jax.distributed DP gang (raytrain-8-worker /
+                   horovod BASELINE configs; CPU ranks, labeled as such)
 - ``transformer``  TransformerLM fwd+bwd tokens/s + MFU at long context,
                    flash (Pallas) vs fused-jnp fallback
-- ``gang``         2-process jax.distributed DP gang (raytrain-8-worker /
-                   horovod BASELINE configs; CPU ranks, labeled as such)
+- ``dlrm``         Criteo-format TSV → dictionary/log preprocess → DLRM
+                   (reference examples/pytorch_dlrm.ipynb workload shape)
+
+Budget discipline (the round-3 failure was a driver timeout that recorded
+NOTHING): every config runs in its own subprocess under a hard per-config
+wall cap, a global ``BENCH_BUDGET_S`` skips whatever does not fit (with an
+explicit ``skipped`` marker), and on a CPU platform every config scales
+itself down to CPU-feasible shapes. The parent process never imports jax, so
+the final JSON line is emitted no matter what any config does.
 
 ``vs_baseline`` compares against the self-measured reference workload: the
 reference publishes no numbers (BASELINE.md), so round 2 measured its
 examples/pytorch_nyctaxi.py pipeline — same data, same preprocessing, same
 5-layer BatchNorm MLP, torch CPU (the reference's own CI hardware class) via
 benchmarks/reference_nyctaxi_torch.py. Select configs with e.g.
-``BENCH_CONFIGS=nyctaxi,transformer``.
+``BENCH_CONFIGS=nyctaxi,transformer``; force the CPU path with
+``BENCH_FORCE_CPU=1`` (the wedged-tunnel drill).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import tempfile
 import time
@@ -36,10 +47,48 @@ REF_NYCTAXI_B8192 = 69_924.2   # samples/s, batch 8192 (apples-to-apples)
 REF_NYCTAXI_B64 = 26_456.9     # samples/s, batch 64 (as the reference ships)
 
 ROWS = int(os.environ.get("BENCH_ROWS", "400000"))
-EPOCHS = int(os.environ.get("BENCH_EPOCHS", "4"))
+EPOCHS = int(os.environ.get("BENCH_EPOCHS", "5"))
 BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
 DLRM_ROWS = int(os.environ.get("BENCH_DLRM_ROWS", "120000"))
 SEQ_LEN = int(os.environ.get("BENCH_SEQ_LEN", "8192"))
+
+# priority order: the primary first, then the two configs no round has yet
+# recorded (gbdt, gang), then the MFU flagship; the budget trims from the end
+CONFIG_ORDER = ["nyctaxi", "gbdt", "keras", "gang", "transformer", "dlrm"]
+#: hard per-config wall caps (seconds) — a config that blows its cap is
+#: killed and recorded as a timeout; the matrix continues
+CONFIG_CAPS_S = {"nyctaxi": 270, "gbdt": 210, "keras": 150, "gang": 480,
+                 "transformer": 360, "dlrm": 330}
+#: total wall target; configs that do not fit inside it are skipped with an
+#: explicit marker (default chosen so the full matrix + startup stays well
+#: under the driver's budget: the round-2 matrix ran ~700 s on TPU)
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1260"))
+#: do not even start a config with less than this much budget left
+MIN_CONFIG_S = 60.0
+
+RESULT_MARK = "##BENCH_RESULT## "
+
+
+def _on_cpu() -> bool:
+    return os.environ.get("RDT_BENCH_PLATFORM", "default").startswith("cpu")
+
+
+def _apply_cpu_scaledown() -> None:
+    """Shrink every knob to CPU-feasible shapes (round 3 died running the
+    T=8192 transformer on the CPU fallback — a shape only a TPU can finish)."""
+    global ROWS, EPOCHS, DLRM_ROWS, SEQ_LEN, BATCH
+    ROWS = min(ROWS, 100_000)
+    DLRM_ROWS = min(DLRM_ROWS, 30_000)
+    SEQ_LEN = min(SEQ_LEN, 1024)
+    BATCH = min(BATCH, 4096)
+    env = os.environ
+    env["BENCH_LM_DIM"] = str(min(int(env.get("BENCH_LM_DIM", "256")), 256))
+    env["BENCH_LM_HEAD_DIM"] = "64"
+    env["BENCH_LM_LAYERS"] = str(min(int(env.get("BENCH_LM_LAYERS", "2")), 2))
+    env["BENCH_LM_STEPS"] = str(min(int(env.get("BENCH_LM_STEPS", "2")), 2))
+    env["BENCH_LM_BATCH"] = "1"
+    env["BENCH_GBDT_ROUNDS"] = str(
+        min(int(env.get("BENCH_GBDT_ROUNDS", "5")), 5))
 
 
 def _num_chips() -> int:
@@ -47,37 +96,77 @@ def _num_chips() -> int:
     return max(1, len(jax.devices()))
 
 
-def _probe_devices(timeout_s: Optional[float] = None) -> bool:
-    """Can a fresh process enumerate devices? Run in a subprocess so a hung
-    init cannot take this process with it. Note: the probe itself briefly
-    claims the chip, so never run bench concurrently with another TPU job
-    (which would be wrong anyway — one process owns the chip). Tune the
+def _probe_devices(timeout_s: Optional[float] = None) -> Optional[str]:
+    """What platform can a fresh process enumerate? Returns the platform name
+    ("tpu", "cpu", ...) or None when device init hangs. Runs in a subprocess
+    so a hung init cannot take this process with it. Note: the probe itself
+    briefly claims the chip, so never run bench concurrently with another TPU
+    job (which would be wrong anyway — one process owns the chip). Tune the
     deadline with BENCH_TPU_PROBE_S.
     """
-    import subprocess
     if timeout_s is None:
-        timeout_s = float(os.environ.get("BENCH_TPU_PROBE_S", "300"))
+        timeout_s = float(os.environ.get("BENCH_TPU_PROBE_S", "240"))
     proc = subprocess.Popen(
-        [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        [sys.executable, "-c",
+         "import jax; print('ok', jax.devices()[0].platform)"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        start_new_session=True)
     try:
         out, _ = proc.communicate(timeout=timeout_s)
-        return proc.returncode == 0 and "ok" in (out or "")
     except subprocess.TimeoutExpired:
-        proc.kill()
-        # no further wait: a child stuck in an uninterruptible device ioctl
-        # is unreapable, and waiting on it would recreate the hang here
-        return False
+        _kill_group(proc)
+        return None
+    for line in (out or "").splitlines():
+        if line.startswith("ok "):
+            return line.split()[1].strip().lower()
+    return None
+
+
+def _kill_group(proc: subprocess.Popen) -> None:
+    """Terminate a config subprocess AND everything it spawned (executor
+    actors, gang ranks). No unbounded wait: a child stuck in an
+    uninterruptible device ioctl is unreapable, and waiting on it would
+    recreate the hang here."""
+    for sig in (signal.SIGTERM, signal.SIGKILL):
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            return
+        try:
+            proc.wait(timeout=5)
+            return
+        except subprocess.TimeoutExpired:
+            continue
 
 
 def _steady(history):
+    """Steady-state samples/s: total samples over total wall across epochs
+    after the first (compile epoch). One long window is far more stable than
+    averaging per-epoch rates — per-epoch numbers swing with host/tunnel load
+    (round-3 verdict: dlrm varied 214k–949k between runs)."""
     rows = history[1:] or history
-    return sum(r["samples_per_s"] for r in rows) / len(rows)
+    wall = sum(r.get("epoch_time_s", 0.0) for r in rows)
+    if wall <= 0:
+        return sum(r["samples_per_s"] for r in rows) / max(len(rows), 1)
+    samples = sum(r["samples_per_s"] * r.get("epoch_time_s", 0.0) for r in rows)
+    return samples / wall
+
+
+def _feed_split(history) -> dict:
+    """Aggregate the feed/dispatch/sync wall split the estimator records per
+    epoch (host-boundness evidence, round-3 verdict Weak #2)."""
+    rows = [r for r in history[1:] if "feed_time_s" in r]
+    if not rows:
+        return {}
+    return {
+        "feed_s": round(sum(r["feed_time_s"] for r in rows), 2),
+        "dispatch_s": round(sum(r["dispatch_time_s"] for r in rows), 2),
+        "device_sync_s": round(sum(r["sync_time_s"] for r in rows), 2),
+    }
 
 
 # steady-state averages over epochs[1:]: anything fewer than 3 epochs leaves
-# a single-epoch window, whose numbers swing ~4x between runs on a loaded
-# host/tunnel
+# a single-epoch window
 STEADY_EPOCHS = max(3, EPOCHS // 2 + 1)
 
 
@@ -115,8 +204,10 @@ def bench_nyctaxi() -> dict:
         t0 = time.perf_counter()
         result = est.fit_on_frame(data)
         wall = time.perf_counter() - t0
-        return {"samples_per_s_per_chip": _steady(result.history) / _num_chips(),
-                "wall_s": round(wall, 1), "rows": ROWS, "batch": BATCH}
+        out = {"samples_per_s_per_chip": _steady(result.history) / _num_chips(),
+               "wall_s": round(wall, 1), "rows": ROWS, "batch": BATCH}
+        out.update(_feed_split(result.history))
+        return out
     finally:
         raydp_tpu.stop()
 
@@ -156,13 +247,15 @@ def bench_dlrm() -> dict:
             label_column=LABEL,
             feature_dtype=np.float64,
             batch_size=min(4096, BATCH),
-            num_epochs=STEADY_EPOCHS,
+            num_epochs=max(STEADY_EPOCHS, 4),
             batch_preprocessor=criteo_batch_preprocessor(NUM_DENSE),
         )
         result = est.fit_on_frame(df)
         wall = time.perf_counter() - t_etl
-        return {"samples_per_s_per_chip": _steady(result.history) / _num_chips(),
-                "wall_s": round(wall, 1), "rows": DLRM_ROWS}
+        out = {"samples_per_s_per_chip": _steady(result.history) / _num_chips(),
+               "wall_s": round(wall, 1), "rows": DLRM_ROWS}
+        out.update(_feed_split(result.history))
+        return out
     finally:
         raydp_tpu.stop()
 
@@ -187,13 +280,15 @@ def bench_keras() -> dict:
 
         def build():
             import keras
-            return keras.Sequential([
-                keras.layers.Input(shape=(len(features),)),
-                keras.layers.Dense(256, activation="relu"),
-                keras.layers.BatchNormalization(),
-                keras.layers.Dense(128, activation="relu"),
-                keras.layers.Dense(1),
-            ])
+            # the NYCTaxiModel shape (256-128-64-32-1 + BatchNorm), so the
+            # keras and flax paths train the same model and their numbers
+            # isolate estimator overhead, not model size (round-3 Weak #6)
+            model = keras.Sequential([keras.layers.Input(shape=(len(features),))])
+            for width in (256, 128, 64, 32):
+                model.add(keras.layers.Dense(width, activation="relu"))
+                model.add(keras.layers.BatchNormalization())
+            model.add(keras.layers.Dense(1))
+            return model
 
         epochs = STEADY_EPOCHS
         est = KerasEstimator(
@@ -206,7 +301,7 @@ def bench_keras() -> dict:
         wall = time.perf_counter() - t0
         return {"samples_per_s_per_chip": _steady(result.history) / _num_chips(),
                 "final_loss": result.history[-1].get("loss"),
-                "wall_s": round(wall, 1)}
+                "model": "nyctaxi-mlp-bn", "wall_s": round(wall, 1)}
     finally:
         raydp_tpu.stop()
 
@@ -270,6 +365,16 @@ def bench_gang() -> dict:
     from compute. Ranks are pinned to CPU (two processes cannot share the one
     physical TPU chip), labeled cpu-gang; ``scaling`` is throughput relative
     to the 1-worker gang.
+
+    What this sweep can and cannot show: this host exposes ONE schedulable
+    CPU core (``os.sched_getaffinity`` = {0}), so every rank process
+    timeshares that core and aggregate compute is constant at any width —
+    rank scaling >1.0 is physically impossible here. The honest claim is the
+    inverse: ``scaling`` near 1.0 at 2/4 ranks means the gang machinery
+    (fan-out, feed sharding, cross-process psum) adds little overhead, which
+    is the property that transfers to real multi-host meshes where each rank
+    owns its own cores/chips. ``host_cpus`` is recorded so the reader can
+    tell which regime produced the number.
     """
     import optax
 
@@ -280,7 +385,8 @@ def bench_gang() -> dict:
     from raydp_tpu.models import NYCTaxiModel
     from raydp_tpu.train import FlaxEstimator
 
-    rows = min(ROWS, 200_000)
+    rows = min(ROWS, 120_000)
+    host_cpus = len(os.sched_getaffinity(0))
     tmp = tempfile.mkdtemp(prefix="rdt-bench-")
     csv_path = os.path.join(tmp, "nyctaxi.csv")
     generate(rows).to_csv(csv_path, index=False)
@@ -326,9 +432,14 @@ def bench_gang() -> dict:
         base = sweep[1]["samples_per_s"] or 1.0
         out = {"samples_per_s_gang": sweep[2]["samples_per_s"],
                "devices": 8, "platform": "cpu-gang", "rows": rows,
+               "host_cpus": host_cpus,
                "sweep": {str(w): v for w, v in sweep.items()},
                "scaling": {str(w): round(v["samples_per_s"] / base, 3)
-                           for w, v in sweep.items()}}
+                           for w, v in sweep.items()},
+               "scaling_note": (
+                   "single-core host: all ranks timeshare one CPU, so >1.0 "
+                   "scaling is impossible; ~1.0 = gang overhead is small"
+                   if host_cpus <= 1 else "")}
         return out
     finally:
         raydp_tpu.stop()
@@ -358,12 +469,15 @@ def _lm_mode_run(mode: str, T: int) -> dict:
     from raydp_tpu.models import TransformerLM, lm_loss
     from raydp_tpu.models.transformer import lm_loss_fused
 
-    dim = int(os.environ.get("BENCH_LM_DIM", "512"))
-    head_dim = int(os.environ.get("BENCH_LM_HEAD_DIM", "64"))
+    # flagship shape (ROOFLINE_LM.md): dim=1024 deepens every dense GEMM's
+    # contraction (K=1024 = 8 MXU passes) and head_dim=128 feeds the MXU
+    # full 128-lanes inside the flash kernel (~60% vs ~51% at head_dim=64)
+    dim = int(os.environ.get("BENCH_LM_DIM", "1024"))
+    head_dim = int(os.environ.get("BENCH_LM_HEAD_DIM", "128"))
     if dim % head_dim:
         raise SystemExit("BENCH_LM_DIM must be a multiple of "
                          "BENCH_LM_HEAD_DIM")
-    layers = int(os.environ.get("BENCH_LM_LAYERS", "4"))
+    layers = int(os.environ.get("BENCH_LM_LAYERS", "8"))
     heads, vocab = dim // head_dim, 32768
     B = int(os.environ.get("BENCH_LM_BATCH", "2"))
     steps = int(os.environ.get("BENCH_LM_STEPS", "8"))
@@ -427,7 +541,8 @@ def _lm_mode_run(mode: str, T: int) -> dict:
     flops_per_tok = 6 * matmul_params + 6 * layers * dim * T
     peak = _peak_flops(jax.devices()[0])
     entry = {"tokens_per_s": round(tok_s, 1), "seq_len": T,
-             "loss": round(float(loss), 3),
+             "loss": round(float(loss), 3), "dim": dim,
+             "head_dim": head_dim, "layers": layers,
              "params_m": round(n_params / 1e6, 1)}
     if peak:
         entry["mfu"] = round(tok_s * flops_per_tok / peak, 4)
@@ -443,10 +558,13 @@ def bench_transformer() -> dict:
     needed vs 15.75G on v5e at T=8192) — that failure must not discard the
     flash number, and dense retries at T/2 until it fits, recording where it
     first OOM'd. The gap IS the point: flash runs contexts dense cannot.
+    Transient (non-OOM) failures retry once: the remote compile helper is
+    known to flake (HTTP 500 / truncated body).
     """
     out = {}
     for mode in ("flash", "dense"):
         t_mode = SEQ_LEN
+        transient_retries = 1
         while True:
             try:
                 entry = _lm_mode_run(mode, t_mode)
@@ -460,6 +578,9 @@ def bench_transformer() -> dict:
                     out.setdefault(f"{mode}_oom_at_seq_len", t_mode)
                     t_mode //= 2
                     continue
+                if not oom and transient_retries > 0:
+                    transient_retries -= 1
+                    continue
                 entry = {"error": f"{type(e).__name__}: {msg[:300]}",
                          "seq_len": t_mode}
                 break
@@ -467,43 +588,102 @@ def bench_transformer() -> dict:
     return out
 
 
-# ----------------------------------------------------------------------- main
-def main():
+# ------------------------------------------------------------ child execution
+CONFIG_FNS = {"nyctaxi": bench_nyctaxi, "dlrm": bench_dlrm,
+              "keras": bench_keras, "transformer": bench_transformer,
+              "gbdt": bench_gbdt, "gang": bench_gang}
+
+
+def _run_config_child(name: str) -> None:
+    """Entry point of a per-config subprocess: run one config, print the
+    result JSON on the marker line. The platform decision arrives via
+    RDT_BENCH_PLATFORM (an env var alone does not override a
+    sitecustomize-registered plugin — the in-process config.update does)."""
     here = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, os.path.join(here, "examples"))
     sys.path.insert(0, here)
+    if _on_cpu():
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        _apply_cpu_scaledown()
+    try:
+        result = CONFIG_FNS[name]()
+    except Exception as e:  # noqa: BLE001 - the parent records the failure
+        result = {"error": f"{type(e).__name__}: {str(e)[:500]}"}
+    print(RESULT_MARK + json.dumps(result), flush=True)
 
+
+def _spawn_config(name: str, cap_s: float, platform: str) -> dict:
+    """Run one config in its own process group under a hard wall cap."""
+    env = dict(os.environ)
+    env["RDT_BENCH_PLATFORM"] = platform
+    if platform != "default":
+        # belt and braces beside the child's in-process config.update; also
+        # keep the TPU plugin from even loading (a plugin touch can hang on
+        # wedged tunnel state, which is exactly the fallback scenario)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_PLATFORM_NAME"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("TPU_NAME", None)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--config", name],
+        stdout=subprocess.PIPE, stderr=None, text=True, env=env,
+        start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=cap_s)
+    except subprocess.TimeoutExpired:
+        _kill_group(proc)
+        return {"timeout_s": cap_s,
+                "error": f"config exceeded its {cap_s:.0f}s wall cap"}
+    for line in (out or "").splitlines():
+        if line.startswith(RESULT_MARK):
+            try:
+                return json.loads(line[len(RESULT_MARK):])
+            except ValueError:
+                break
+    return {"error": f"config subprocess rc={proc.returncode}, "
+                     "no result line"}
+
+
+# ----------------------------------------------------------------------- main
+def main():
+    t_start = time.perf_counter()
     platform = "default"
     if os.environ.get("BENCH_FORCE_CPU") == "1":
-        # in-process override is the only platform selection a startup hook
-        # cannot trump (see .claude/skills/verify/SKILL.md gotchas)
-        import jax
-        jax.config.update("jax_platforms", "cpu")
         platform = "cpu(forced)"
-    elif not _probe_devices():
-        # a wedged TPU tunnel blocks device init forever; a CPU run with an
-        # explicit marker beats a bench that never reports
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        platform = "cpu(tpu-unavailable-fallback)"
-        print("# TPU device init timed out; falling back to CPU",
-              file=sys.stderr)
+    else:
+        probed = _probe_devices()
+        if probed is None:
+            platform = "cpu(tpu-unavailable-fallback)"
+            print("# TPU device init timed out; falling back to CPU",
+                  file=sys.stderr)
+        elif probed == "cpu":
+            # a CPU-only host (no accelerator plugin): label it honestly and
+            # scale configs down — the flagship shapes are accelerator-sized
+            platform = "cpu(host-default)"
 
     selected = [c.strip() for c in os.environ.get(
-        "BENCH_CONFIGS",
-        "nyctaxi,dlrm,keras,transformer,gbdt,gang").split(",")
-        if c.strip()]
-    table = {"nyctaxi": bench_nyctaxi, "dlrm": bench_dlrm,
-             "keras": bench_keras, "transformer": bench_transformer,
-             "gbdt": bench_gbdt, "gang": bench_gang}
+        "BENCH_CONFIGS", ",".join(CONFIG_ORDER)).split(",") if c.strip()]
+    # probe time counts against the budget (a slow-but-alive tunnel must not
+    # push the matrix past the driver's wall)
+    deadline = t_start + BUDGET_S
+
     extra = {}
     primary = None
     for name in selected:
+        remaining = deadline - time.perf_counter()
+        if remaining < MIN_CONFIG_S:
+            skip = {"skipped": "budget",
+                    "remaining_s": round(max(remaining, 0.0), 1)}
+            extra[name] = skip
+            if name == "nyctaxi":
+                primary = skip  # a budget-dropped primary is 0.0, not "not selected"
+            print(f"# {name}: skipped (budget exhausted, "
+                  f"{remaining:.0f}s left)", file=sys.stderr)
+            continue
+        cap = min(float(CONFIG_CAPS_S.get(name, 300)), remaining)
         t0 = time.perf_counter()
-        try:
-            result = table[name]()
-        except Exception as e:  # keep the matrix going; record the failure
-            result = {"error": f"{type(e).__name__}: {str(e)[:500]}"}
+        result = _spawn_config(name, cap, platform)
         result["config_wall_s"] = round(time.perf_counter() - t0, 1)
         if name == "nyctaxi":
             primary = result
@@ -514,6 +694,8 @@ def main():
         "metric": "nyctaxi_e2e_train_samples_per_sec_per_chip",
         "unit": "samples/s/chip",
         "platform": platform,
+        "total_wall_s": round(time.perf_counter() - t_start, 1),
+        "budget_s": BUDGET_S,
         "baseline_note": "self-measured reference workload, torch CPU "
                          f"batch 8192 ({REF_NYCTAXI_B8192:.0f} samples/s; "
                          f"batch-64-as-shipped: {REF_NYCTAXI_B64:.0f})",
@@ -522,8 +704,9 @@ def main():
     if primary is None:
         # headline config not selected: null, not a fake measured 0.0
         out.update(value=None, vs_baseline=None, skipped_primary=True)
-    elif "error" in primary:
-        out.update(value=0.0, vs_baseline=0.0, error=primary["error"])
+    elif "error" in primary or "skipped" in primary:
+        out.update(value=0.0, vs_baseline=0.0,
+                   error=primary.get("error", primary.get("skipped")))
     else:
         value = round(primary["samples_per_s_per_chip"], 1)
         out.update(value=value,
@@ -532,4 +715,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) == 3 and sys.argv[1] == "--config":
+        _run_config_child(sys.argv[2])
+    else:
+        main()

@@ -61,40 +61,28 @@ class _Context:
         if self.address is not None:
             # attach/client mode: join a standalone head's cluster instead of
             # booting an in-process runtime (parity: Ray-client mode,
-            # reference conftest.py:77-140)
-            if self.placement_group_strategy is not None:
-                raise NotImplementedError(
-                    "placement_group_strategy is not supported in attach "
-                    "mode yet; create groups on the head side")
+            # reference conftest.py:77-140). Placement groups are created on
+            # the HEAD's resource model over RPC, exactly like the
+            # reference's pg pre-allocation under Ray client
+            # (reference context.py:119-140).
             from raydp_tpu.runtime.client import ClientContext
             from raydp_tpu.runtime.head import adopt_runtime
-            adopt_runtime(ClientContext(self.address))
+            runtime = ClientContext(self.address)
+            adopt_runtime(runtime)
+            self._preallocate_group(runtime)
             self.session = Session(
                 app_name=self.app_name,
                 num_executors=self.num_executors,
                 executor_cores=self.executor_cores,
                 executor_memory=self.executor_memory,
                 config=self.config,
+                placement_group=self._placement_group,
             )
             self.session.start()
             return self.session
 
         runtime = init_runtime(config=self.config, virtual_nodes=self.virtual_nodes)
-
-        if self.placement_group_strategy is not None:
-            # one {CPU, memory} bundle per executor (parity: context.py:119-140)
-            bundles = [
-                {"CPU": float(self.executor_cores), "memory": float(self.executor_memory)}
-                for _ in range(self.num_executors)
-            ]
-            group = runtime.resource_manager.create_group(
-                bundles, self.placement_group_strategy)
-            self._placement_group = group
-            self.config.set(cfg.PLACEMENT_GROUP_KEY, group.group_id)
-            self.config.set(
-                cfg.PLACEMENT_GROUP_BUNDLE_INDEXES_KEY,
-                ",".join(str(b.index) for b in group.bundles),
-            )
+        self._preallocate_group(runtime)
 
         self.session = Session(
             app_name=self.app_name,
@@ -106,6 +94,26 @@ class _Context:
         )
         self.session.start()
         return self.session
+
+    def _preallocate_group(self, runtime) -> None:
+        """One {CPU, memory} bundle per executor (parity: context.py:119-140);
+        works against the in-process ResourceManager and the client-mode RPC
+        proxy alike."""
+        if self.placement_group_strategy is None:
+            return
+        bundles = [
+            {"CPU": float(self.executor_cores),
+             "memory": float(self.executor_memory)}
+            for _ in range(self.num_executors)
+        ]
+        group = runtime.resource_manager.create_group(
+            bundles, self.placement_group_strategy)
+        self._placement_group = group
+        self.config.set(cfg.PLACEMENT_GROUP_KEY, group.group_id)
+        self.config.set(
+            cfg.PLACEMENT_GROUP_BUNDLE_INDEXES_KEY,
+            ",".join(str(b.index) for b in group.bundles),
+        )
 
     def stop(self, cleanup_data: bool = True) -> None:
         """Teardown order parity (context.py:152-169): master shutdown → session

@@ -143,9 +143,25 @@ class DistributedDataset:
 
     # ---- transforms ---------------------------------------------------------
     def random_shuffle(self, seed: Optional[int] = None) -> "DistributedDataset":
-        """Shuffle block order + rows within blocks (cheap two-level shuffle;
-        the reference's estimators call ``ds.random_shuffle()`` before training,
-        torch/estimator.py:335-338)."""
+        """Uniform random shuffle across ALL rows (the reference's estimators
+        call ``ds.random_shuffle()`` before training, torch/estimator.py:335-338,
+        where ray.data shuffles executor-side).
+
+        With a live session this runs as distributed shuffle tasks on the
+        executors (map: random bucketing; reduce: in-partition permutation) —
+        the driver moves only refs, never rows. Without a session (e.g. a
+        dataset rebuilt from :meth:`portable` inside an SPMD rank) it falls
+        back to a local two-level shuffle.
+        """
+        if self._session is not None and self.num_blocks() > 0:
+            refs = [self.get_block_ref(i) for i in range(self.num_blocks())]
+            schema_bytes = self._schema.serialize().to_pybytes()
+            new_refs, rows = self._session.engine.random_shuffle_refs(
+                refs, schema_bytes, seed, owner=self._owner)
+            blocks = [BlockMeta(num_rows=n, ref=r)
+                      for r, n in zip(new_refs, rows)]
+            return DistributedDataset(blocks, self._schema, self._owner,
+                                      session=self._session)
         rng = np.random.RandomState(seed if seed is not None else 0)
         order = rng.permutation(self.num_blocks())
         client = get_client()

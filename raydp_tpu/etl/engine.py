@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import cloudpickle
 import numpy as np
 import pyarrow as pa
+import pyarrow.compute as pc
 
 from raydp_tpu.etl import plan as P
 from raydp_tpu.etl import tasks as T
@@ -176,6 +177,18 @@ class Engine:
         self.owner = owner
 
     @staticmethod
+    def _gather_buckets(results: Sequence[Dict[str, Any]], num_buckets: int,
+                        temps: List[ObjectRef]) -> List[List[ObjectRef]]:
+        """Transpose map-task shuffle outputs (map × bucket → bucket × map),
+        registering every intermediate ref in ``temps``."""
+        buckets: List[List[ObjectRef]] = [[] for _ in range(num_buckets)]
+        for r in results:
+            for b, ref in enumerate(r["bucket_refs"]):
+                buckets[b].append(ref)
+                temps.append(ref)
+        return buckets
+
+    @staticmethod
     def _free(temps: List[ObjectRef]) -> None:
         if temps:
             try:
@@ -262,6 +275,47 @@ class Engine:
                             executors=executors, recover_tasks=recover_blobs,
                             schema=schema, pinned_refs=temps)
 
+    def random_shuffle_refs(self, refs: Sequence[ObjectRef],
+                            schema_bytes: Optional[bytes],
+                            seed: Optional[int],
+                            owner: Optional[str] = None,
+                            ) -> Tuple[List[ObjectRef], List[int]]:
+        """Executor-side uniform shuffle of materialized blocks.
+
+        Two stages over the store data plane — map: seeded random bucketing
+        of each block (:func:`tasks.random_buckets`); reduce: concat each
+        bucket + in-partition permutation (:class:`tasks.LocalShuffleStep`).
+        The driver handles ONLY refs: no row ever crosses the driver process
+        (the reference's shuffle is likewise distributed — ray.data
+        random_shuffle at torch/estimator.py:335-338). Returns (refs, rows)
+        per output block; intermediates are freed before returning.
+        """
+        temps: List[ObjectRef] = []
+        try:
+            nb = max(1, len(refs))
+            base = 0 if seed is None else int(seed)
+            map_tasks = [
+                self._task(T.ArrowRefSource([r], schema=schema_bytes))
+                .with_output(output=T.SHUFFLE, num_buckets=nb,
+                             shuffle_seed=(base * 1_000_003 + i) & 0x7FFFFFFF,
+                             owner=self.owner)
+                for i, r in enumerate(refs)
+            ]
+            results = self.pool.run_tasks(
+                map_tasks, self._locality([[r] for r in refs]))
+            buckets = self._gather_buckets(results, nb, temps)
+            reduce_tasks = [
+                self._task(T.ArrowRefSource(bucket, schema=schema_bytes),
+                           [T.LocalShuffleStep(
+                               (base * 9_176 + 77 + b) & 0x7FFFFFFF)])
+                .with_output(output=T.RETURN_REF, owner=owner or self.owner)
+                for b, bucket in enumerate(buckets)
+            ]
+            out = self.pool.run_tasks(reduce_tasks, self._locality(buckets))
+            return [r["ref"] for r in out], [r["num_rows"] for r in out]
+        finally:
+            self._free(temps)
+
     def num_partitions(self, node: P.PlanNode) -> int:
         temps: List[ObjectRef] = []
         try:
@@ -345,6 +399,9 @@ class Engine:
 
         if isinstance(node, P.Sort):
             return self._compile_sort(node, temps)
+
+        if isinstance(node, P.Distinct):
+            return self._compile_distinct(node, temps)
 
         if isinstance(node, P.Union):
             all_tasks, all_pref = [], []
@@ -439,12 +496,7 @@ class Engine:
                  for t in tasks]
         results = self.pool.run_tasks(tasks, preferred)
         schema = results[0]["schema"] if results else None
-        buckets: List[List[ObjectRef]] = [[] for _ in range(num_buckets)]
-        for r in results:
-            for b, ref in enumerate(r["bucket_refs"]):
-                buckets[b].append(ref)
-                temps.append(ref)
-        return buckets, schema
+        return self._gather_buckets(results, num_buckets, temps), schema
 
     def _compile_repartition(self, node: P.Repartition, temps: List[ObjectRef]):
         n = node.num_partitions
@@ -489,23 +541,29 @@ class Engine:
                                       in zip(left_buckets, right_buckets)])
 
     def _compile_sort(self, node: P.Sort, temps: List[ObjectRef]):
-        """Range-partitioned sort: materialize the child ONCE, sample boundary
-        values from EVERY block on the executors (any orderable type — no
-        numeric cast), range-shuffle those refs, locally sort each range."""
-        key, order = node.keys[0]
+        """Range-partitioned sort on the COMPOSITE key: materialize the child
+        ONCE, sample boundary key-tuples from EVERY block on the executors
+        (any orderable type — no numeric cast), range-shuffle those refs by
+        lexicographic comparison, locally sort each range. Composite
+        boundaries keep the partitioning balanced even when the first key has
+        few distinct values (per-key boundaries would collapse there)."""
+        keys = node.keys
+        key_names = [k for k, _ in keys]
         refs, schema, num_rows = self._materialize_inner(node.child, None, temps)
         temps.extend(refs)
 
         # boundary sample: a bounded uniform sample over ALL blocks, taken by
         # the executors — sampling only the first blocks skews the range
-        # boundaries on sorted or clustered input
+        # boundaries on sorted or clustered input. Only the key columns
+        # travel back to the driver.
         nb = min(self.shuffle_partitions, max(1, len(self.pool.executors) * 2))
         total = sum(num_rows)
         target = max(1000, 100 * nb)
         frac = min(1.0, target / total) if total else 0.0
         sample_tasks = [
             self._task(T.ArrowRefSource([ref], schema=schema),
-                       [T.SampleStep(frac, seed=0, partition_index=i)]
+                       [T.SampleStep(frac, seed=0, partition_index=i),
+                        T.ProjectStep([(k, _col(k)) for k in key_names])]
                        ).with_output(output=T.COLLECT)
             for i, (ref, n) in enumerate(zip(refs, num_rows)) if n > 0
         ]
@@ -514,41 +572,98 @@ class Engine:
             for r in self.pool.run_tasks(sample_tasks):
                 tbl = pa.ipc.open_stream(pa.py_buffer(r["ipc"])).read_all()
                 if tbl.num_rows:
-                    sampled.append(tbl.column(key))
-        if not sampled:
-            boundaries: List = []
-        else:
-            # null keys are routed to a fixed bucket, never ranged: a null
-            # boundary would poison every comparison (null > null = null)
-            values = pa.concat_arrays(
-                [c.combine_chunks() for c in sampled]).drop_null().sort()
-            qpos = [int(q * (len(values) - 1))
-                    for q in np.linspace(0, 1, nb + 1)[1:-1]] if len(values) \
-                else []
-            boundaries = []
-            for p in qpos:
-                v = values[p].as_py()
-                if not boundaries or v != boundaries[-1]:
-                    boundaries.append(v)
+                    sampled.append(tbl)
+        boundaries: List[Tuple] = []
+        if sampled:
+            sample = pa.concat_tables(sampled, promote_options="permissive")
+            # rows with a null or NaN key need no boundary: both always sort
+            # at the extreme (and either as a boundary value would poison
+            # every comparison — NaN > x and NaN == x are both false)
+            for k in key_names:
+                column = sample.column(k)
+                sample = sample.filter(pc.is_valid(column))
+                column = sample.column(k)
+                if pa.types.is_floating(column.type) and sample.num_rows:
+                    sample = sample.filter(pc.invert(pc.is_nan(column)))
+            if sample.num_rows:
+                sample = sample.sort_by(keys)
+                qpos = [int(q * (sample.num_rows - 1))
+                        for q in np.linspace(0, 1, nb + 1)[1:-1]]
+                cols = {k: sample.column(k) for k in key_names}
+                for p in qpos:
+                    tup = tuple(cols[k][p].as_py() for k in key_names)
+                    if not boundaries or tup != boundaries[-1]:
+                        boundaries.append(tup)
 
-        # ascending: null keys must land in the LAST bucket (sort_by is
-        # at_end); descending reverses the buckets, so nulls stay in bucket 0
         shuffle_tasks = [
             self._task(T.ArrowRefSource([ref], schema=schema)).with_output(
                 output=T.SHUFFLE, num_buckets=len(boundaries) + 1,
-                range_key=(key, boundaries, order == "ascending"),
+                range_key=(list(keys), boundaries),
                 owner=self.owner)
             for ref in refs
         ]
         results = self.pool.run_tasks(shuffle_tasks)
-        buckets: List[List[ObjectRef]] = [[] for _ in range(len(boundaries) + 1)]
-        for r in results:
-            for b, ref in enumerate(r["bucket_refs"]):
-                buckets[b].append(ref)
-                temps.append(ref)
-        if order == "descending":
-            buckets = buckets[::-1]
+        buckets = self._gather_buckets(results, len(boundaries) + 1, temps)
+        # buckets come out in global sort order for any direction mix (the
+        # composite comparison honors per-key direction; nulls sort last)
         tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
                             [T.LocalSortStep(node.keys)])
                  for bucket in buckets]
         return tasks, self._locality(buckets)
+
+    def _compile_distinct(self, node: P.Distinct, temps: List[ObjectRef]):
+        """distinct / dropDuplicates: hash-shuffle on the key columns (the
+        ``["*"]`` sentinel = full row, resolved executor-side), then local
+        first-per-key dedupe — equal keys share a bucket, so local dedupe is
+        globally exact."""
+        nb = min(self.shuffle_partitions, max(1, len(self.pool.executors) * 2))
+        keys = list(node.subset) if node.subset else ["*"]
+        buckets, schema = self._shuffle_children(node.child, nb, keys=keys,
+                                                 temps=temps)
+        tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
+                            [T.DistinctStep(node.subset)])
+                 for bucket in buckets]
+        return tasks, self._locality(buckets)
+
+    # ---- driver-merged summaries -------------------------------------------
+    def describe(self, node: P.PlanNode, cols: List[str]) -> Dict[str, Dict]:
+        """count/mean/stddev/min/max per column: executors reduce each
+        partition to one row of moment partials (DescribeStep); the driver
+        merges K tiny rows, never the data. Sample stddev (ddof=1), matching
+        Spark's ``describe``."""
+        temps: List[ObjectRef] = []
+        try:
+            tasks, preferred = self._compile(node, temps)
+            tasks = [t.with_output(steps=t.steps + [T.DescribeStep(cols)],
+                                   output=T.COLLECT)
+                     for t in tasks]
+            results = self.pool.run_tasks(tasks, preferred)
+        finally:
+            self._free(temps)
+        agg = {c: {"count": 0, "sum": 0.0, "sumsq": 0.0,
+                   "min": None, "max": None} for c in cols}
+        for r in results:
+            tbl = pa.ipc.open_stream(pa.py_buffer(r["ipc"])).read_all()
+            row = {name: tbl.column(name)[0].as_py()
+                   for name in tbl.column_names}
+            for c in cols:
+                a = agg[c]
+                a["count"] += int(row[f"{c}:count"])
+                a["sum"] += float(row[f"{c}:sum"])
+                a["sumsq"] += float(row[f"{c}:sumsq"])
+                for fn, key in ((min, "min"), (max, "max")):
+                    v = row[f"{c}:{key}"]
+                    if v is not None:
+                        a[key] = v if a[key] is None else fn(a[key], v)
+        out: Dict[str, Dict] = {}
+        for c, a in agg.items():
+            n = a["count"]
+            mean = a["sum"] / n if n else None
+            if n > 1:
+                var = max(0.0, (a["sumsq"] - a["sum"] ** 2 / n) / (n - 1))
+                std = math.sqrt(var)
+            else:
+                std = None
+            out[c] = {"count": n, "mean": mean, "stddev": std,
+                      "min": a["min"], "max": a["max"]}
+        return out

@@ -147,10 +147,16 @@ class EtlExecutor:
         if task.output == T.SHUFFLE:
             if task.range_key is not None:
                 key, boundaries, *rest = task.range_key
-                buckets = T.range_buckets(table, key, boundaries,
-                                          nulls_high=bool(rest and rest[0]))
+                if isinstance(key, str):  # legacy single-key format
+                    buckets = T.range_buckets(table, key, boundaries,
+                                              nulls_high=bool(rest and rest[0]))
+                else:  # composite: key = [(name, order), ...]
+                    buckets = T.range_buckets_multi(table, key, boundaries)
             elif task.shuffle_keys:
                 buckets = T.hash_buckets(table, task.shuffle_keys, task.num_buckets)
+            elif task.shuffle_seed is not None:
+                buckets = T.random_buckets(table, task.num_buckets,
+                                           task.shuffle_seed)
             else:
                 start = T.hash_bytes(task.task_id) % max(task.num_buckets, 1)
                 buckets = T.round_robin_buckets(table, task.num_buckets, start)

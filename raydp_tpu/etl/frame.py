@@ -121,6 +121,44 @@ class DataFrame:
 
     orderBy = sort
 
+    def distinct(self) -> "DataFrame":
+        """Deduplicate whole rows (parity: Spark ``distinct``; reference
+        usage examples/data_process.py). Executor-side hash-shuffle dedupe."""
+        return self._with(P.Distinct(self._plan, None), schema=self._schema)
+
+    def dropDuplicates(self, subset: Optional[Sequence[str]] = None
+                       ) -> "DataFrame":
+        """Keep one row per distinct value of ``subset`` (None → all
+        columns); which row survives is unspecified, as in Spark."""
+        return self._with(
+            P.Distinct(self._plan, list(subset) if subset else None),
+            schema=self._schema)
+
+    drop_duplicates = dropDuplicates
+
+    def describe(self, *cols: str) -> "DataFrame":
+        """count/mean/stddev/min/max summary of numeric columns (parity:
+        Spark ``describe``, reference usage examples/data_process.py). The
+        executors reduce partitions to moment partials; the driver merges
+        those tiny rows and returns a small local frame with a ``summary``
+        column, so ``describe().show()`` works as in Spark."""
+        names = list(cols)
+        if not names:
+            names = [f.name for f in self.schema
+                     if pa.types.is_integer(f.type)
+                     or pa.types.is_floating(f.type)]
+        if not names:
+            raise ValueError("describe: no numeric columns")
+        stats = self._session.engine.describe(self._plan, names)
+        rows = ["count", "mean", "stddev", "min", "max"]
+        data = {"summary": rows}
+        for c in names:
+            data[c] = [float(stats[c][r]) if stats[c][r] is not None
+                       else None for r in rows]
+        import pandas as pd
+        return self._session.createDataFrame(pd.DataFrame(data),
+                                             num_partitions=1)
+
     def join(self, other: "DataFrame", on: Union[str, List[str]],
              how: str = "inner") -> "DataFrame":
         keys = [on] if isinstance(on, str) else list(on)

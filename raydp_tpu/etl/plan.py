@@ -165,6 +165,18 @@ class Sort(PlanNode):
         return [self.child]
 
 
+@dataclass
+class Distinct(PlanNode):
+    """Row dedupe over ``subset`` (None → all columns): hash-shuffle on the
+    key columns, then local first-row-per-key dedupe in each bucket."""
+
+    child: PlanNode
+    subset: Optional[List[str]] = None
+
+    def children(self):
+        return [self.child]
+
+
 # ==== n-ary ========================================================================
 @dataclass
 class Join(PlanNode):

@@ -242,11 +242,71 @@ class SplitSelectStep(Step):
 
 
 @dataclass
+class LocalShuffleStep(Step):
+    """Uniform random permutation of the rows of one partition — the reduce
+    side of the distributed ``random_shuffle`` (map side: :func:`random_buckets`).
+    Runs on the executors; the driver never sees row data."""
+
+    seed: int
+
+    def run(self, table: pa.Table) -> pa.Table:
+        if table.num_rows <= 1:
+            return table
+        rng = np.random.RandomState(self.seed)
+        return table.take(pa.array(rng.permutation(table.num_rows)))
+
+
+@dataclass
 class LimitStep(Step):
     n: int
 
     def run(self, table: pa.Table) -> pa.Table:
         return table.slice(0, self.n)
+
+
+@dataclass
+class DistinctStep(Step):
+    """First row per key (``subset``; None → all columns). Globally correct
+    when rows were hash-shuffled by the same keys: equal keys share a bucket.
+    Keeps original row order of the surviving first occurrences
+    (parity surface: Spark ``distinct``/``dropDuplicates``,
+    reference examples/data_process.py)."""
+
+    subset: Optional[List[str]] = None
+
+    def run(self, table: pa.Table) -> pa.Table:
+        keys = self.subset or table.column_names
+        if table.num_rows == 0:
+            return table
+        row_col = "__rdt_row__"
+        aug = table.append_column(
+            row_col, pa.array(np.arange(table.num_rows, dtype=np.int64)))
+        firsts = aug.group_by(keys).aggregate([(row_col, "min")])
+        take = firsts.column(f"{row_col}_min").combine_chunks()
+        take = take.take(pc.sort_indices(take))  # preserve original order
+        return table.take(take)
+
+
+@dataclass
+class DescribeStep(Step):
+    """Per-partition moment partials for ``describe``: one row of
+    count/sum/sumsq/min/max per column. The driver merges these K tiny rows —
+    never the data."""
+
+    cols: List[str]
+
+    def run(self, table: pa.Table) -> pa.Table:
+        out = {}
+        for c in self.cols:
+            v = pc.cast(table.column(c).drop_null(), pa.float64(), safe=False)
+            s = pc.sum(v).as_py()
+            sq = pc.sum(pc.multiply(v, v)).as_py()
+            out[f"{c}:count"] = [len(v)]
+            out[f"{c}:sum"] = [0.0 if s is None else float(s)]
+            out[f"{c}:sumsq"] = [0.0 if sq is None else float(sq)]
+            out[f"{c}:min"] = [pc.min(v).as_py()]
+            out[f"{c}:max"] = [pc.max(v).as_py()]
+        return pa.table(out)
 
 
 @dataclass
@@ -310,6 +370,7 @@ class Task:
     # SHUFFLE parameters
     num_buckets: int = 0
     shuffle_keys: Optional[List[str]] = None      # None → round-robin repartition
+    shuffle_seed: Optional[int] = None            # set → seeded random bucketing
     # CACHE parameter
     cache_key: Optional[str] = None
     # range-partition spec for sort (overrides hash bucketing):
@@ -336,7 +397,11 @@ def hash_buckets(table: pa.Table, keys: Sequence[str], num_buckets: int) -> List
 
     Uses a stable numpy-side hash over the key columns so map tasks on different
     executors agree — Python's ``hash`` is salted per process and unusable here.
+    The sentinel key list ``["*"]`` means "all columns" (used by ``distinct``,
+    whose key set is the full row and unknown until the table is loaded).
     """
+    if list(keys) == ["*"]:
+        keys = table.column_names
     if table.num_rows == 0:
         return [table] * num_buckets
     acc = np.zeros(table.num_rows, dtype=np.uint64)
@@ -358,12 +423,68 @@ def hash_bytes(s: str) -> int:
     return zlib.crc32(s.encode()) & 0xFFFFFFFF
 
 
+def random_buckets(table: pa.Table, num_buckets: int,
+                   seed: int) -> List[pa.Table]:
+    """Seeded uniform random bucket assignment — the map side of the
+    distributed ``random_shuffle``. Deterministic per (seed, partition), so a
+    recomputed map task lands every row in the same bucket."""
+    if table.num_rows == 0:
+        return [table] * num_buckets
+    rng = np.random.RandomState(seed)
+    bucket = rng.randint(0, num_buckets, size=table.num_rows)
+    return [table.filter(pa.array(bucket == b)) for b in range(num_buckets)]
+
+
 def round_robin_buckets(table: pa.Table, num_buckets: int,
                         start: int = 0) -> List[pa.Table]:
     if table.num_rows == 0:
         return [table] * num_buckets
     idx = (np.arange(table.num_rows) + start) % num_buckets
     return [table.filter(pa.array(idx == b)) for b in range(num_buckets)]
+
+
+def range_buckets_multi(table: pa.Table, keys: List[Tuple[str, str]],
+                        boundaries: List[Tuple]) -> List[pa.Table]:
+    """Range partitioning on a COMPOSITE sort key.
+
+    ``keys`` are ``(column, "ascending"|"descending")`` pairs; ``boundaries``
+    are key tuples drawn from a sorted sample. A row's bucket is the number of
+    boundaries it sorts AFTER — lexicographic comparison honoring each key's
+    direction, with null keys sorting last (matching ``sort_by``'s ``at_end``
+    placement) — so buckets come out already in global sort order for any
+    direction mix, no reversal step. Single-key skew is why this exists: with
+    a low-cardinality first key, per-key boundaries collapse and only the
+    composite key can spread rows."""
+    bucket = np.zeros(table.num_rows, dtype=np.int64)
+    cols = {name: table.column(name).combine_chunks() for name, _ in keys}
+    nan_masks = {}
+    for name, _ in keys:
+        arr = cols[name]
+        if pa.types.is_floating(arr.type):
+            nan_masks[name] = pc.fill_null(pc.is_nan(arr), False)
+    for bvals in boundaries:
+        after = None
+        # build lexicographic "sorts after boundary" from the LAST key back:
+        # after_k = gt_k OR (eq_k AND after_{k+1})
+        for (name, order), b in reversed(list(zip(keys, bvals))):
+            arr = cols[name]
+            cmp = pc.less if order == "descending" else pc.greater
+            gt = pc.fill_null(cmp(arr, pa.scalar(b)), True)  # nulls sort last
+            nan = nan_masks.get(name)
+            if nan is not None and order != "descending":
+                # Arrow orders NaN above every number: ascending sorts place
+                # it after any boundary (pc.greater says False there);
+                # descending already gets bucket 0 from pc.less = False
+                gt = pc.or_(gt, nan)
+            if after is None:
+                after = gt
+            else:
+                eq = pc.fill_null(pc.equal(arr, pa.scalar(b)), False)
+                after = pc.or_(gt, pc.and_(eq, after))
+        if after is not None:
+            bucket += np.asarray(after, dtype=np.int64)
+    return [table.filter(pa.array(bucket == i))
+            for i in range(len(boundaries) + 1)]
 
 
 def range_buckets(table: pa.Table, key: str, boundaries: List,

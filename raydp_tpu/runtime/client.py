@@ -50,6 +50,31 @@ class _StoreTableProxy:
         return _call
 
 
+class _ResourceManagerProxy:
+    """The ResourceManager slice a client-mode driver needs — placement
+    groups created/removed on the HEAD's resource model over RPC (parity:
+    the reference's pg pre-allocation works under Ray client,
+    reference context.py:119-140 + conftest.py:77-140)."""
+
+    def __init__(self, head):
+        self._head = head
+
+    def create_group(self, bundles, strategy):
+        from raydp_tpu.runtime.placement import group_from_dict
+        strategy = getattr(strategy, "value", strategy)
+        d = self._head.call("create_placement_group", list(bundles),
+                            str(strategy), timeout=60.0)
+        return group_from_dict(d)
+
+    def get_group(self, group_id: str):
+        from raydp_tpu.runtime.placement import group_from_dict
+        d = self._head.call("get_placement_group", group_id)
+        return group_from_dict(d) if d else None
+
+    def remove_group(self, group_id: str) -> None:
+        self._head.call("remove_placement_group", group_id)
+
+
 class ClientContext:
     """A driver attached to a standalone head. Runtime-protocol compatible
     where the framework needs it; everything rides the head RPC."""
@@ -68,6 +93,7 @@ class ClientContext:
         #: empty on purpose: records live in the head; locality helpers
         #: degrade gracefully (Session._executor_hosts finds no entries)
         self.records: Dict[str, Any] = {}
+        self.resource_manager = _ResourceManagerProxy(self.head)
         self._lock = threading.RLock()
 
         # data plane: on the head's machine we map its shared memory

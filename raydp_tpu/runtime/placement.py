@@ -65,6 +65,19 @@ class PlacementGroup:
         return self.bundles[index].node_id
 
 
+def group_from_dict(d: Dict) -> PlacementGroup:
+    """Rebuild a PlacementGroup from its RPC wire form (head._group_to_dict):
+    the client-mode driver works with the same dataclass the in-process
+    runtime hands out."""
+    return PlacementGroup(
+        group_id=d["group_id"],
+        strategy=PlacementStrategy(d["strategy"]),
+        bundles=[Bundle(b["index"], dict(b["resources"]), b.get("node_id"))
+                 for b in d["bundles"]],
+        created=True,
+    )
+
+
 class ResourceManager:
     """Tracks logical nodes, allocates actor/bundle resources, places groups."""
 

@@ -341,19 +341,38 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 mstats = tuple(m.init() for m in metrics)
                 loss_sum = np.zeros((), np.float32)
                 steps, samples = 0, 0
-                for batch in feed:
+                t_feed = t_disp = 0.0
+                it = iter(feed)
+                while True:
+                    tf = time.perf_counter()
+                    batch = next(it, None)
+                    t_feed += time.perf_counter() - tf
+                    if batch is None:
+                        break
+                    td = time.perf_counter()
                     state, loss_sum, mstats = jit_train(state, batch, mstats,
                                                         loss_sum)
+                    t_disp += time.perf_counter() - td
                     steps += 1
                     samples += self.batch_size
+                # fetch the accumulated loss BEFORE reading the clock:
+                # dispatch is async (and on a remote-tunnel backend even
+                # block_until_ready can return early), so only a host scalar
+                # fetch makes the epoch wall include the device work — without
+                # it per-epoch throughput swings ~4x between runs
+                ts = time.perf_counter()
+                train_loss = float(loss_sum) / steps if steps else float("nan")
+                t_sync = time.perf_counter() - ts
                 dt = time.perf_counter() - t0
                 report = {
                     "epoch": epoch,
-                    "train_loss": float(loss_sum) / steps if steps
-                    else float("nan"),
+                    "train_loss": train_loss,
                     "steps": steps,
                     "samples_per_s": samples / dt if dt > 0 else 0.0,
                     "epoch_time_s": dt,
+                    "feed_time_s": t_feed,
+                    "dispatch_time_s": t_disp,
+                    "sync_time_s": t_sync,
                 }
                 for m, s in zip(metrics, mstats):
                     report[f"train_{m.name}"] = m.compute(

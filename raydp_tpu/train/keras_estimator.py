@@ -434,11 +434,14 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                         tv, ntv, ov, mvars, loss_sum, batch)
                     steps += 1
                     samples += self.batch_size
+                # fetch the loss scalar BEFORE reading the clock: dispatch is
+                # async, so only a host fetch makes the epoch wall include
+                # the device work (stable across runs; see flax_estimator)
+                loss_host = float(loss_sum) / steps if steps else float("nan")
                 dt = _time.perf_counter() - t0
                 report = {
                     "epoch": epoch,
-                    "loss": float(loss_sum) / steps if steps
-                    else float("nan"),
+                    "loss": loss_host,
                     "epoch_time_s": dt,
                     "samples_per_s": samples / dt if dt > 0 else 0.0,
                 }

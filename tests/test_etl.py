@@ -343,3 +343,90 @@ def test_dynamic_allocation_shrink_grow(session):
     assert df2.count() == 4000
     out = df2.groupBy("y").agg(F.count("x").alias("n")).to_pandas()
     assert int(out["n"].sum()) == 4000
+
+
+def test_distinct_and_drop_duplicates(session):
+    """distinct/dropDuplicates parity (reference examples/data_process.py):
+    executor-side hash-shuffle dedupe, exact global result."""
+    pdf = pd.DataFrame({
+        "a": [1, 1, 2, 2, 3] * 40,
+        "b": ["x", "x", "y", "z", "x"] * 40,
+    })
+    df = session.createDataFrame(pdf, num_partitions=4)
+    out = df.distinct().to_pandas().sort_values(["a", "b"]).reset_index(drop=True)
+    exp = pdf.drop_duplicates().sort_values(["a", "b"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(out, exp)
+
+    # subset dedupe keeps one full row per key value
+    by_a = df.dropDuplicates(["a"]).to_pandas()
+    assert sorted(by_a["a"]) == [1, 2, 3]
+    assert set(by_a.columns) == {"a", "b"}
+
+    # dedupe after a transform, with nulls (null is a distinct value)
+    pdf2 = pd.DataFrame({"k": [1.0, None, 1.0, None, 2.0]})
+    df2 = session.createDataFrame(pdf2, num_partitions=2)
+    assert df2.distinct().count() == 3
+
+
+def test_describe(session):
+    rng = np.random.RandomState(7)
+    pdf = pd.DataFrame({"x": rng.normal(10, 3, 2000),
+                        "y": rng.randint(0, 5, 2000),
+                        "s": ["t"] * 2000})
+    df = session.createDataFrame(pdf, num_partitions=4)
+    out = df.describe().to_pandas().set_index("summary")
+    assert "s" not in out.columns  # non-numeric skipped
+    assert out.loc["count", "x"] == 2000
+    np.testing.assert_allclose(out.loc["mean", "x"], pdf["x"].mean(), rtol=1e-9)
+    np.testing.assert_allclose(out.loc["stddev", "x"], pdf["x"].std(ddof=1),
+                               rtol=1e-9)
+    assert out.loc["min", "y"] == pdf["y"].min()
+    assert out.loc["max", "y"] == pdf["y"].max()
+    # explicit column selection
+    one = df.describe("y").to_pandas()
+    assert list(one.columns) == ["summary", "y"]
+
+
+def test_sort_mixed_directions(session):
+    """Composite-key range sort with per-key direction mix: ascending primary,
+    descending secondary — the boundary comparison must honor each key's
+    direction (single-key bucketing reversed globally and broke this)."""
+    rng = np.random.RandomState(3)
+    n = 3000
+    a = rng.randint(0, 4, n)
+    b = rng.randint(0, 500, n)
+    df = session.createDataFrame(pd.DataFrame({"a": a, "b": b}),
+                                 num_partitions=6)
+    out = df.sort(("a", "ascending"), ("b", "descending")) \
+        .to_pandas().reset_index(drop=True)
+    exp = pd.DataFrame({"a": a, "b": b}).sort_values(
+        ["a", "b"], ascending=[True, False]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(out, exp)
+
+
+def test_sort_low_cardinality_primary_balanced(session):
+    """With 2 distinct primary values, composite boundaries must still spread
+    rows over >2 range partitions (single-key boundaries collapse to 1)."""
+    rng = np.random.RandomState(5)
+    n = 4000
+    pdf = pd.DataFrame({"a": rng.randint(0, 2, n), "b": rng.permutation(n)})
+    df = session.createDataFrame(pdf, num_partitions=8)
+    sorted_df = df.sort("a", "b")
+    assert sorted_df.num_partitions() > 2
+    out = sorted_df.to_pandas().reset_index(drop=True)
+    exp = pdf.sort_values(["a", "b"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(out, exp)
+
+
+def test_sort_float_with_nans(session):
+    """NaN sort keys must land at the global end (Arrow orders NaN above all
+    numbers), not in the first range partition (code-review r4 finding)."""
+    rng = np.random.RandomState(11)
+    vals = rng.rand(2000) * 100
+    vals[rng.choice(2000, 25, replace=False)] = np.nan
+    df = session.createDataFrame(pd.DataFrame({"x": vals}), num_partitions=6)
+    out = df.sort("x").to_pandas()["x"].to_numpy()
+    finite = out[~np.isnan(out)]
+    assert len(finite) == 2000 - 25
+    assert (np.diff(finite) >= 0).all()
+    assert np.isnan(out[-25:]).all()  # NaNs contiguous at the end
