@@ -51,6 +51,10 @@ EPOCHS = int(os.environ.get("BENCH_EPOCHS", "5"))
 BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
 DLRM_ROWS = int(os.environ.get("BENCH_DLRM_ROWS", "120000"))
 SEQ_LEN = int(os.environ.get("BENCH_SEQ_LEN", "8192"))
+# train steps chained per dispatch (lax.scan): divides the ~64 ms
+# remote-tunnel round trip per dispatch by this factor; numerically identical
+# to per-batch dispatch (tests/test_train.py chain parity)
+CHAIN = int(os.environ.get("BENCH_CHAIN", "8"))
 
 # priority order: the primary first, then the two configs no round has yet
 # recorded (gbdt, gang), then the MFU flagship; the budget trims from the end
@@ -200,6 +204,7 @@ def bench_nyctaxi() -> dict:
             batch_size=BATCH,
             num_epochs=EPOCHS,
             shuffle=True,
+            steps_per_dispatch=CHAIN,
         )
         t0 = time.perf_counter()
         result = est.fit_on_frame(data)
@@ -249,6 +254,7 @@ def bench_dlrm() -> dict:
             batch_size=min(4096, BATCH),
             num_epochs=max(STEADY_EPOCHS, 4),
             batch_preprocessor=criteo_batch_preprocessor(NUM_DENSE),
+            steps_per_dispatch=CHAIN,
         )
         result = est.fit_on_frame(df)
         wall = time.perf_counter() - t_etl

@@ -350,18 +350,20 @@ class DeviceFeed:
             self._base_seed = self.host_iter.seed
         self.host_iter.seed = (self._base_seed + (epoch + 1) * 1000003) % (2**31 - 1)
 
-    def _place(self, batch: Dict[str, np.ndarray]):
+    def _place(self, batch: Dict[str, np.ndarray], sharding=None):
         jax = self._jax
-        if self._sharding is None:
+        sharding = sharding if sharding is not None else self._sharding
+        if sharding is None:
             return {n: jax.device_put(a) for n, a in batch.items()}
         if jax.process_count() > 1:
             return {
-                n: jax.make_array_from_process_local_data(self._sharding, a)
+                n: jax.make_array_from_process_local_data(sharding, a)
                 for n, a in batch.items()
             }
-        return {n: jax.device_put(a, self._sharding) for n, a in batch.items()}
+        return {n: jax.device_put(a, sharding) for n, a in batch.items()}
 
-    def __iter__(self):
+    def _host_batches(self):
+        """Host batches through the background prefetch thread."""
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
         SENTINEL = object()
@@ -388,6 +390,50 @@ class DeviceFeed:
                     return
                 if isinstance(item, BaseException):
                     raise item
-                yield self._place(item)
+                yield item
         finally:
             stop.set()
+
+    def __iter__(self):
+        for batch in self._host_batches():
+            yield self._place(batch)
+
+    def chained(self, k: int):
+        """Yield ``(placed_stack, n)``: up to ``k`` host batches stacked on a
+        new leading (scan) dim and placed with ONE transfer — the inputs of a
+        ``lax.scan``-chained train dispatch. On a remote-tunnel backend each
+        dispatch+fetch costs a full round trip (~64 ms measured), so chaining
+        k steps divides that overhead by k. The scan dim is unsharded; the
+        batch dim keeps the feed's data sharding. A smaller final stack (the
+        epoch remainder) compiles once more and is otherwise fine."""
+        if k <= 1:
+            for batch in self:
+                yield batch, 1
+            return
+        stacked_sharding = None
+        if self._sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            stacked_sharding = NamedSharding(
+                self.mesh, PartitionSpec(None, *tuple(self._sharding.spec)))
+
+        def _flush(buf):
+            stacked = {n: np.stack([b[n] for b in buf]) for n in buf[0]}
+            return self._place(stacked, sharding=stacked_sharding), len(buf)
+
+        def _rows(b: Dict[str, np.ndarray]) -> int:
+            return next(iter(b.values())).shape[0]
+
+        buf: List[Dict[str, np.ndarray]] = []
+        for batch in self._host_batches():
+            if buf and _rows(batch) != _rows(buf[0]):
+                # ragged batch (the drop_remainder=False epoch tail): it
+                # cannot stack with full batches — flush what we have, then
+                # let it travel alone
+                yield _flush(buf)
+                buf = []
+            buf.append(batch)
+            if len(buf) == k:
+                yield _flush(buf)
+                buf = []
+        if buf:
+            yield _flush(buf)

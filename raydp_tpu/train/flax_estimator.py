@@ -121,6 +121,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         compute_dtype=None,
         drop_last: bool = True,
         callbacks: Optional[Sequence[Callable[[Dict], None]]] = None,
+        steps_per_dispatch: int = 1,
     ):
         if model is None and model_creator is None:
             raise ValueError("pass model or model_creator")
@@ -147,6 +148,11 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         self.compute_dtype = compute_dtype
         self.drop_last = drop_last
         self.callbacks = list(callbacks or [])
+        #: chain this many train steps inside ONE jitted dispatch (lax.scan
+        #: over a stacked batch). Numerically identical to dispatching each
+        #: batch (same update sequence); the win is k× fewer host→device
+        #: round trips, which dominate on a remote-tunnel TPU (~64 ms each).
+        self.steps_per_dispatch = max(1, int(steps_per_dispatch))
         self._result: Optional[TrainingResult] = None
 
     # ------------------------------------------------------------------ build
@@ -320,6 +326,24 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         jit_train = jax.jit(train_step, donate_argnums=(0, 3))
         jit_eval = jax.jit(eval_step, donate_argnums=(3,))
 
+        chain = self.steps_per_dispatch
+        jit_chain = None
+        if chain > 1:
+            from jax import lax
+
+            def train_chain(state, batches, mstats, loss_sum):
+                def body(carry, batch):
+                    state, loss_sum, mstats = carry
+                    state, loss_sum, mstats = train_step(
+                        state, batch, mstats, loss_sum)
+                    return (state, loss_sum, mstats), ()
+
+                (state, loss_sum, mstats), _ = lax.scan(
+                    body, (state, loss_sum, mstats), batches)
+                return state, loss_sum, mstats
+
+            jit_chain = jax.jit(train_chain, donate_argnums=(0, 3))
+
         history: List[Dict[str, float]] = []
         epoch = 0
         retries = 0
@@ -342,19 +366,25 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 loss_sum = np.zeros((), np.float32)
                 steps, samples = 0, 0
                 t_feed = t_disp = 0.0
-                it = iter(feed)
+                it = feed.chained(chain) if chain > 1 else iter(feed)
                 while True:
                     tf = time.perf_counter()
-                    batch = next(it, None)
+                    item = next(it, None)
                     t_feed += time.perf_counter() - tf
-                    if batch is None:
+                    if item is None:
                         break
                     td = time.perf_counter()
-                    state, loss_sum, mstats = jit_train(state, batch, mstats,
-                                                        loss_sum)
+                    if chain > 1:
+                        batches, k = item
+                        state, loss_sum, mstats = jit_chain(
+                            state, batches, mstats, loss_sum)
+                    else:
+                        k = 1
+                        state, loss_sum, mstats = jit_train(state, item,
+                                                            mstats, loss_sum)
                     t_disp += time.perf_counter() - td
-                    steps += 1
-                    samples += self.batch_size
+                    steps += k
+                    samples += self.batch_size * k
                 # fetch the accumulated loss BEFORE reading the clock:
                 # dispatch is async (and on a remote-tunnel backend even
                 # block_until_ready can return early), so only a host scalar

@@ -189,6 +189,34 @@ def test_engine_locality_prefers_owner_node(runtime):
         _, preferred = engine._compile(
             P.InMemory([remote_ref, local_ref], schema), temps=[])
         assert preferred == ["ex-remote", "ex-local"]
+
+        # the reverse-conversion path reads through the same locality-routed
+        # plan: to_frame emits exactly this InMemory node over the dataset's
+        # refs (parity: RayDatasetRDD.getPreferredLocations over block owner
+        # addresses, RayDatasetRDD.scala:48-56 — the reference's raw-bytes
+        # second branch collapses into the single store here)
+        from raydp_tpu.data.dataset import BlockMeta, DistributedDataset
+
+        ds = DistributedDataset(
+            [BlockMeta(num_rows=512, ref=remote_ref),
+             BlockMeta(num_rows=512, ref=local_ref)],
+            pa.schema([("x", pa.int64())]))
+
+        class _Master:
+            def add_objects(self, holder_id, refs):
+                self.held = (holder_id, refs)
+
+        class _Session:
+            master = _Master()
+            master_name = None
+            engine = None
+
+        from raydp_tpu.data.dataset import to_frame
+        frame = to_frame(ds, session=_Session())
+        assert isinstance(frame._plan, P.InMemory)
+        assert frame._plan.refs == [remote_ref, local_ref]
+        _, preferred2 = engine._compile(frame._plan, temps=[])
+        assert preferred2 == ["ex-remote", "ex-local"]
     finally:
         _kill(agent)
 

@@ -25,3 +25,15 @@ def test_entry_compiles():
 def test_dryrun_multichip_8():
     mod = _load()
     mod.dryrun_multichip(8)
+
+
+def test_dryrun_parity_catches_wrong_sharding(monkeypatch):
+    """The dry run's parity gate must FAIL on a deliberately wrong sharding
+    (a missed psum: loss averaged over the local batch shard only) — proof
+    the allclose check detects wrong-but-finite numbers (VERDICT r3 #5)."""
+    import pytest
+
+    mod = _load()
+    monkeypatch.setenv("RDT_DRYRUN_SABOTAGE", "1")
+    with pytest.raises(RuntimeError, match="parity|diverges|Mismatch"):
+        mod.dryrun_multichip(8)
