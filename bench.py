@@ -301,7 +301,8 @@ def bench_keras() -> dict:
             model_builder=build, optimizer="adam", loss="mse",
             feature_columns=features, label_column=LABEL,
             batch_size=min(BATCH, 4096), num_epochs=epochs,
-            data_parallel=_num_chips() > 1)
+            data_parallel=_num_chips() > 1,
+            steps_per_dispatch=CHAIN)
         t0 = time.perf_counter()
         result = est.fit_on_frame(data)
         wall = time.perf_counter() - t0

@@ -176,6 +176,11 @@ class Engine:
         self.shuffle_partitions = shuffle_partitions
         self.owner = owner
 
+    def _num_buckets(self) -> int:
+        """Reduce-side bucket count for wide operators: capped by the
+        configured shuffle parallelism, scaled to the executor pool."""
+        return min(self.shuffle_partitions, max(1, len(self.pool.executors) * 2))
+
     @staticmethod
     def _gather_buckets(results: Sequence[Dict[str, Any]], num_buckets: int,
                         temps: List[ObjectRef]) -> List[List[ObjectRef]]:
@@ -403,6 +408,9 @@ class Engine:
         if isinstance(node, P.Distinct):
             return self._compile_distinct(node, temps)
 
+        if isinstance(node, P.WindowOp):
+            return self._compile_window(node, temps)
+
         if isinstance(node, P.Union):
             all_tasks, all_pref = [], []
             for child in node.inputs:
@@ -516,7 +524,7 @@ class Engine:
         return tasks, self._locality(buckets)
 
     def _compile_groupagg(self, node: P.GroupAgg, temps: List[ObjectRef]):
-        nb = min(self.shuffle_partitions, max(1, len(self.pool.executors) * 2))
+        nb = self._num_buckets()
         buckets, schema = self._shuffle_children(node.child, nb, keys=node.keys,
                                                  temps=temps)
         tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
@@ -525,7 +533,7 @@ class Engine:
         return tasks, self._locality(buckets)
 
     def _compile_join(self, node: P.Join, temps: List[ObjectRef]):
-        nb = min(self.shuffle_partitions, max(1, len(self.pool.executors) * 2))
+        nb = self._num_buckets()
         left_buckets, lschema = self._shuffle_children(node.left, nb, node.keys,
                                                        temps)
         right_buckets, rschema = self._shuffle_children(node.right, nb,
@@ -556,7 +564,7 @@ class Engine:
         # the executors — sampling only the first blocks skews the range
         # boundaries on sorted or clustered input. Only the key columns
         # travel back to the driver.
-        nb = min(self.shuffle_partitions, max(1, len(self.pool.executors) * 2))
+        nb = self._num_buckets()
         total = sum(num_rows)
         target = max(1000, 100 * nb)
         frac = min(1.0, target / total) if total else 0.0
@@ -616,7 +624,7 @@ class Engine:
         ``["*"]`` sentinel = full row, resolved executor-side), then local
         first-per-key dedupe — equal keys share a bucket, so local dedupe is
         globally exact."""
-        nb = min(self.shuffle_partitions, max(1, len(self.pool.executors) * 2))
+        nb = self._num_buckets()
         keys = list(node.subset) if node.subset else ["*"]
         buckets, schema = self._shuffle_children(node.child, nb, keys=keys,
                                                  temps=temps)
@@ -624,6 +632,27 @@ class Engine:
                             [T.DistinctStep(node.subset)])
                  for bucket in buckets]
         return tasks, self._locality(buckets)
+
+    def _compile_window(self, node: P.WindowOp, temps: List[ObjectRef]):
+        """Window function: equal partition keys share a bucket (hash
+        shuffle), so per-bucket sorted evaluation is globally exact. Without
+        partition keys everything collapses to one task (Spark's "No
+        Partition Defined" single-partition path)."""
+        step = T.WindowStep(list(node.partition_keys), list(node.order_keys),
+                            node.out_name, node.fn, node.arg_col,
+                            node.offset, node.default)
+        if node.partition_keys:
+            nb = self._num_buckets()
+            buckets, schema = self._shuffle_children(
+                node.child, nb, keys=list(node.partition_keys), temps=temps)
+            tasks = [self._task(T.ArrowRefSource(bucket, schema=schema), [step])
+                     for bucket in buckets]
+            return tasks, self._locality(buckets)
+        refs, schema, _ = self._materialize_inner(node.child, None, temps)
+        temps.extend(refs)
+        tasks = [self._task(T.ArrowRefSource(list(refs), schema=schema),
+                            [step])]
+        return tasks, self._locality([list(refs)])
 
     # ---- driver-merged summaries -------------------------------------------
     def describe(self, node: P.PlanNode, cols: List[str]) -> Dict[str, Dict]:

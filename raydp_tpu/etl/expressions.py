@@ -399,6 +399,19 @@ class AggExpr:
     def alias(self, name: str) -> "AggExpr":
         return AggExpr(self.fn, self.column, name)
 
+    def over(self, spec):
+        """Evaluate this aggregate as a window function over ``spec``
+        (Spark: ``F.sum("x").over(Window.partitionBy("k"))`` broadcasts the
+        per-partition aggregate to every row)."""
+        from raydp_tpu.etl.window import WindowExpr
+
+        supported = {"mean", "sum", "min", "max", "count"}
+        if self.fn not in supported:
+            raise ValueError(
+                f"aggregate {self.fn!r} is not supported over a window; "
+                f"have {sorted(supported)}")
+        return WindowExpr(self.fn, spec, arg_col=self.column)
+
 
 class _DtAccessor:
     """Datetime component extraction (examples/data_process.py uses dayofweek,

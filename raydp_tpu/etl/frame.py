@@ -56,7 +56,44 @@ class DataFrame:
                 raise TypeError(f"cannot select {c!r}")
         return self._with(P.Project(self._plan, columns))
 
-    def withColumn(self, name: str, expr: Expr) -> "DataFrame":
+    def withColumn(self, name: str, expr) -> "DataFrame":
+        from raydp_tpu.etl.window import WindowExpr
+
+        if isinstance(expr, WindowExpr):
+            # window columns are a wide op (shuffle by partition keys), not a
+            # per-partition projection. Replacing an existing column drops it
+            # first (WindowStep appends) — unless the window itself reads it.
+            base = self._plan
+            if name in self.columns:
+                used = set(expr.spec.partition_keys)
+                used.update(k for k, _ in expr.spec.order_keys)
+                if expr.arg_col:
+                    used.add(expr.arg_col)
+                if name in used:
+                    raise ValueError(
+                        f"withColumn({name!r}) would replace a column the "
+                        "window function reads; use a different output name")
+                base = self.drop(name)._plan
+            # derive the output schema statically: without it, chaining a
+            # second window column would run the first one's whole shuffle
+            # just to list column names (the schema property's limit-1 probe)
+            schema = None
+            if self._schema is not None:
+                from raydp_tpu.etl.tasks import window_output_type
+                arg_t = None
+                if expr.arg_col and expr.arg_col != "*":
+                    i = self._schema.get_field_index(expr.arg_col)
+                    arg_t = self._schema.field(i).type if i >= 0 else None
+                base_schema = self._schema
+                if name in base_schema.names:
+                    base_schema = base_schema.remove(
+                        base_schema.get_field_index(name))
+                schema = base_schema.append(
+                    pa.field(name, window_output_type(expr.fn, arg_t)))
+            return self._with(P.WindowOp(
+                base, list(expr.spec.partition_keys),
+                list(expr.spec.order_keys), name, expr.fn,
+                expr.arg_col, expr.offset, expr.default), schema=schema)
         columns = [(n, e) for n, e in self._all_columns() if n != name]
         columns.append((name, _wrap(expr)))
         return self._with(P.Project(self._plan, columns))

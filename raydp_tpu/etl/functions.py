@@ -25,6 +25,7 @@ __all__ = [
     "upper", "lower", "trim", "length", "concat",
     "mean", "avg", "sum", "count", "max", "min", "stddev", "variance",
     "first", "last", "count_distinct",
+    "row_number", "rank", "dense_rank", "lag", "lead",
 ]
 
 
@@ -177,3 +178,29 @@ def last(c: str) -> AggExpr:
 
 def count_distinct(c: str) -> AggExpr:
     return AggExpr("count_distinct", c)
+
+
+# -- window functions (Spark: F.row_number().over(Window...)) ------------------------
+def row_number():
+    from raydp_tpu.etl.window import WindowFunction
+    return WindowFunction("row_number")
+
+
+def rank():
+    from raydp_tpu.etl.window import WindowFunction
+    return WindowFunction("rank")
+
+
+def dense_rank():
+    from raydp_tpu.etl.window import WindowFunction
+    return WindowFunction("dense_rank")
+
+
+def lag(c: str, offset: int = 1, default=None):
+    from raydp_tpu.etl.window import WindowFunction
+    return WindowFunction("lag", arg_col=c, offset=offset, default=default)
+
+
+def lead(c: str, offset: int = 1, default=None):
+    from raydp_tpu.etl.window import WindowFunction
+    return WindowFunction("lead", arg_col=c, offset=offset, default=default)

@@ -166,6 +166,26 @@ class Sort(PlanNode):
 
 
 @dataclass
+class WindowOp(PlanNode):
+    """One window-function column: hash-shuffle by ``partition_keys``, sort
+    each bucket by (partition, order) keys, compute ``fn`` executor-side.
+    No partition keys → single-partition evaluation (Spark's "No Partition
+    Defined" path)."""
+
+    child: PlanNode
+    partition_keys: List[str]
+    order_keys: List[Tuple[str, str]]
+    out_name: str
+    fn: str
+    arg_col: Optional[str] = None
+    offset: int = 1
+    default: object = None
+
+    def children(self):
+        return [self.child]
+
+
+@dataclass
 class Distinct(PlanNode):
     """Row dedupe over ``subset`` (None → all columns): hash-shuffle on the
     key columns, then local first-row-per-key dedupe in each bucket."""

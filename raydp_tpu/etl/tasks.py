@@ -287,6 +287,104 @@ class DistinctStep(Step):
         return table.take(take)
 
 
+def window_output_type(fn: str, arg_type=None) -> pa.DataType:
+    """Static output type of a window function — used by the empty-bucket
+    path AND the frame's derived schema, so both agree with what the
+    non-empty pandas/numpy compute actually produces (e.g. lag/lead over
+    integers yields float64: pandas shift introduces NaN holes)."""
+    if fn in ("row_number", "rank", "dense_rank", "count"):
+        return pa.int64()
+    if fn == "mean":
+        return pa.float64()
+    if fn in ("lag", "lead"):
+        if arg_type is not None and pa.types.is_integer(arg_type):
+            return pa.float64()
+        return arg_type if arg_type is not None else pa.float64()
+    # sum/min/max keep the argument's type
+    return arg_type if arg_type is not None else pa.float64()
+
+
+@dataclass
+class WindowStep(Step):
+    """Evaluate one window function over a bucket that holds every row of its
+    partitions (guaranteed by the hash shuffle on the partition keys).
+
+    Rows are sorted by (partition, order) keys; group/tie boundaries are
+    computed positionally (factorized codes — null-safe, any dtype), ranks by
+    numpy index arithmetic, lag/lead/aggregates by a pandas groupby on the
+    integer partition id (dtype-preserving: the computed column is appended
+    to the ORIGINAL arrow table, none of its columns round-trip)."""
+
+    part_keys: List[str]
+    order_keys: List[Tuple[str, str]]
+    out_name: str
+    fn: str
+    arg_col: Optional[str] = None
+    offset: int = 1
+    default: object = None
+
+    def run(self, table: pa.Table) -> pa.Table:
+        import pandas as pd
+
+        n = table.num_rows
+        if n == 0:
+            arg_t = (table.schema.field(self.arg_col).type
+                     if self.arg_col and self.arg_col != "*" else None)
+            typ = window_output_type(self.fn, arg_t)
+            return table.append_column(self.out_name, pa.array([], typ))
+        sort_spec = ([(k, "ascending") for k in self.part_keys]
+                     + list(self.order_keys))
+        tbl = table.sort_by(sort_spec) if sort_spec else table
+
+        def change_mask(keys) -> np.ndarray:
+            mask = np.zeros(n, dtype=bool)
+            mask[0] = True
+            for k in keys:
+                codes, _ = pd.factorize(tbl.column(k).to_pandas(),
+                                        use_na_sentinel=True)
+                mask[1:] |= codes[1:] != codes[:-1]
+            return mask
+
+        idx = np.arange(n, dtype=np.int64)
+        group_start = change_mask(self.part_keys) if self.part_keys \
+            else (idx == 0)
+        grp_first = np.maximum.accumulate(np.where(group_start, idx, 0))
+
+        fn = self.fn
+        if fn == "row_number":
+            out = pa.array(idx - grp_first + 1)
+        elif fn in ("rank", "dense_rank"):
+            tie_start = group_start | change_mask(
+                [k for k, _ in self.order_keys])
+            if fn == "rank":
+                tie_first = np.maximum.accumulate(np.where(tie_start, idx, 0))
+                out = pa.array(tie_first - grp_first + 1)
+            else:
+                ties = np.cumsum(tie_start)
+                out = pa.array(ties - ties[grp_first] + 1)
+        elif fn == "count" and self.arg_col in (None, "*"):
+            # count("*") = partition row count broadcast to every row
+            part_id = np.cumsum(group_start)
+            out = pa.array(np.bincount(part_id)[part_id].astype(np.int64))
+        else:
+            if self.arg_col is None or self.arg_col == "*":
+                raise ValueError(f"window function {fn!r} needs a column")
+            part_id = np.cumsum(group_start)
+            series = tbl.column(self.arg_col).to_pandas()
+            g = series.groupby(part_id)
+            if fn in ("sum", "mean", "min", "max", "count"):
+                out_s = g.transform(fn)
+            elif fn in ("lag", "lead"):
+                shift = self.offset if fn == "lag" else -self.offset
+                out_s = g.shift(shift)
+                if self.default is not None:
+                    out_s = out_s.where(out_s.notna(), self.default)
+            else:
+                raise ValueError(f"unknown window function {fn!r}")
+            out = pa.Array.from_pandas(out_s)
+        return tbl.append_column(self.out_name, out)
+
+
 @dataclass
 class DescribeStep(Step):
     """Per-partition moment partials for ``describe``: one row of

@@ -27,7 +27,8 @@ def _linear_df(session, n=2048, parts=4):
     return session.createDataFrame(pdf, num_partitions=parts)
 
 
-def _estimator(num_epochs=3, callbacks=None, ckpt_dir=None):
+def _estimator(num_epochs=3, callbacks=None, ckpt_dir=None,
+               steps_per_dispatch=1):
     import optax
 
     return FlaxEstimator(
@@ -41,6 +42,7 @@ def _estimator(num_epochs=3, callbacks=None, ckpt_dir=None):
         shuffle=False,
         checkpoint_dir=ckpt_dir,
         callbacks=callbacks,
+        steps_per_dispatch=steps_per_dispatch,
     )
 
 
@@ -54,7 +56,11 @@ def test_gang_losses_match_single_process(session, tmp_path):
     single = _estimator(ckpt_dir=str(tmp_path / "single"))
     r1 = single.fit(train_ds, test_ds)
 
-    gang = _estimator(ckpt_dir=str(tmp_path / "gang"))
+    # the gang additionally runs CHAINED dispatch (lax.scan over stacked
+    # batches assembled with make_array_from_process_local_data): matching
+    # the unchained single-process run proves the chain is exact in the
+    # multi-process path too
+    gang = _estimator(ckpt_dir=str(tmp_path / "gang"), steps_per_dispatch=2)
     r2 = gang.fit_gang(train_ds, test_ds, num_workers=2, run_timeout=900.0)
 
     assert len(r2.history) == len(r1.history)
