@@ -180,8 +180,48 @@ class DistributedDataset:
                      ) -> List[List[Tuple[int, int, int]]]:
         """Balanced shard plan: per rank, ``(block_index, offset, length)`` with
         equal per-rank sample counts (the ``divide_blocks`` kernel,
-        utils.py:149-222 — offsets here since a rank may take part of a block)."""
-        assignment = divide_blocks(self.block_sizes(), world_size,
+        utils.py:149-222 — offsets here since a rank may take part of a block).
+
+        With MORE ranks than blocks — where ``divide_blocks`` has no whole
+        block per rank and the reference repartitions first
+        (test_torch_sequential.py:23-54) — the plan falls back to contiguous
+        row ranges: rank ``r`` reads rows ``[r·per, (r+1)·per)`` of the
+        concatenated dataset, wrapping past the end so every rank still gets
+        exactly ``ceil(total/world)`` samples (the SPMD no-short-rank rule).
+        """
+        sizes = self.block_sizes()
+        if world_size > len(sizes):
+            total = sum(sizes)
+            if total == 0:
+                return [[] for _ in range(world_size)]
+            per = -(-total // world_size)
+            starts = np.cumsum([0] + list(sizes))
+            # shuffle here is coarse, like divide_blocks' block shuffle: a
+            # seeded rotation of the global row space plus a permutation of
+            # the rank→slice mapping, so ranks draw different data each epoch
+            # (per-row shuffling belongs to the feed's in-batch shuffle)
+            rotation = 0
+            order = np.arange(world_size)
+            if shuffle:
+                rng = np.random.RandomState(seed if seed is not None else 0)
+                rotation = int(rng.randint(total))
+                order = rng.permutation(world_size)
+
+            def runs(start: int, stop: int) -> List[Tuple[int, int, int]]:
+                out: List[Tuple[int, int, int]] = []
+                row = start
+                while row < stop:
+                    r = row % total
+                    b = int(np.searchsorted(starts, r, side="right")) - 1
+                    take = int(min(stop - row, starts[b + 1] - r))
+                    out.append((b, r - int(starts[b]), take))
+                    row += take
+                return out
+
+            return [runs(int(order[r]) * per + rotation,
+                         (int(order[r]) + 1) * per + rotation)
+                    for r in range(world_size)]
+        assignment = divide_blocks(sizes, world_size,
                                    shuffle=shuffle, shuffle_seed=seed)
         plans: List[List[Tuple[int, int, int]]] = []
         for rank in range(world_size):

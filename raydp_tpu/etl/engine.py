@@ -637,21 +637,38 @@ class Engine:
         """Window function: equal partition keys share a bucket (hash
         shuffle), so per-bucket sorted evaluation is globally exact. Without
         partition keys everything collapses to one task (Spark's "No
-        Partition Defined" single-partition path)."""
-        step = T.WindowStep(list(node.partition_keys), list(node.order_keys),
-                            node.out_name, node.fn, node.arg_col,
-                            node.offset, node.default)
+        Partition Defined" single-partition path).
+
+        Adjacent WindowOps over the SAME partition keys collapse into one
+        shuffle feeding a chain of WindowSteps (innermost first) — Spark
+        likewise evaluates same-spec window functions in a single exchange;
+        the doc example chains three columns over one spec and must not pay
+        three shuffles of the whole dataset."""
+        def _step(w: P.WindowOp) -> T.WindowStep:
+            return T.WindowStep(list(w.partition_keys), list(w.order_keys),
+                                w.out_name, w.fn, w.arg_col,
+                                w.offset, w.default)
+
+        steps = [_step(node)]
+        child = node.child
+        while (isinstance(child, P.WindowOp)
+               and list(child.partition_keys) == list(node.partition_keys)):
+            steps.append(_step(child))
+            child = child.child
+        steps.reverse()  # innermost (first-defined) column computes first
+
         if node.partition_keys:
             nb = self._num_buckets()
             buckets, schema = self._shuffle_children(
-                node.child, nb, keys=list(node.partition_keys), temps=temps)
-            tasks = [self._task(T.ArrowRefSource(bucket, schema=schema), [step])
+                child, nb, keys=list(node.partition_keys), temps=temps)
+            tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
+                                list(steps))
                      for bucket in buckets]
             return tasks, self._locality(buckets)
-        refs, schema, _ = self._materialize_inner(node.child, None, temps)
+        refs, schema, _ = self._materialize_inner(child, None, temps)
         temps.extend(refs)
         tasks = [self._task(T.ArrowRefSource(list(refs), schema=schema),
-                            [step])]
+                            list(steps))]
         return tasks, self._locality([list(refs)])
 
     # ---- driver-merged summaries -------------------------------------------

@@ -363,9 +363,15 @@ class WindowStep(Step):
                 ties = np.cumsum(tie_start)
                 out = pa.array(ties - ties[grp_first] + 1)
         elif fn == "count" and self.arg_col in (None, "*"):
-            # count("*") = partition row count broadcast to every row
             part_id = np.cumsum(group_start)
-            out = pa.array(np.bincount(part_id)[part_id].astype(np.int64))
+            if self.order_keys:
+                # running row count (RANGE frame: order-key peers share it)
+                rows = idx - grp_first + 1
+                out = pa.array(self._range_frame(rows, group_start,
+                                                 change_mask, n))
+            else:
+                # count("*") = partition row count broadcast to every row
+                out = pa.array(np.bincount(part_id)[part_id].astype(np.int64))
         else:
             if self.arg_col is None or self.arg_col == "*":
                 raise ValueError(f"window function {fn!r} needs a column")
@@ -373,7 +379,26 @@ class WindowStep(Step):
             series = tbl.column(self.arg_col).to_pandas()
             g = series.groupby(part_id)
             if fn in ("sum", "mean", "min", "max", "count"):
-                out_s = g.transform(fn)
+                if self.order_keys:
+                    # Spark's default frame WITH orderBy is unboundedPreceding
+                    # ..currentRow — a RUNNING aggregate whose RANGE frame
+                    # includes order-key peers (ties share the value)
+                    nn_cum = series.notna().astype("int64") \
+                        .groupby(part_id).cumsum()
+                    if fn == "sum":
+                        out_s = g.cumsum()
+                    elif fn == "min":
+                        out_s = g.cummin()
+                    elif fn == "max":
+                        out_s = g.cummax()
+                    elif fn == "count":
+                        out_s = nn_cum
+                    else:  # mean
+                        out_s = g.cumsum() / nn_cum
+                    out_s = pd.Series(self._range_frame(
+                        out_s.to_numpy(), group_start, change_mask, n))
+                else:
+                    out_s = g.transform(fn)
             elif fn in ("lag", "lead"):
                 shift = self.offset if fn == "lag" else -self.offset
                 out_s = g.shift(shift)
@@ -383,6 +408,19 @@ class WindowStep(Step):
                 raise ValueError(f"unknown window function {fn!r}")
             out = pa.Array.from_pandas(out_s)
         return tbl.append_column(self.out_name, out)
+
+    def _range_frame(self, rows_cumulative: np.ndarray,
+                     group_start: np.ndarray, change_mask, n: int
+                     ) -> np.ndarray:
+        """ROWS-frame running values → RANGE frame: every row takes the value
+        of the LAST row of its order-key tie group (Spark's default frame
+        includes current-row peers)."""
+        import pandas as pd
+
+        tie_start = group_start | change_mask([k for k, _ in self.order_keys])
+        tie_id = np.cumsum(tie_start)
+        return pd.Series(rows_cumulative).groupby(tie_id) \
+            .transform("last").to_numpy()
 
 
 @dataclass

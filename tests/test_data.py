@@ -173,3 +173,22 @@ def test_shard_spec_feed(session):
         shard=ShardSpec(plans[0]), shuffle=False)
     rows = sum(b["label"].shape[0] for b in it)
     assert rows == 300
+
+
+def test_split_shards_more_ranks_than_blocks(session):
+    """More gang workers than dataset blocks: the shard plan wraps around
+    (ranks re-read block prefixes) so every rank still gets the same sample
+    count — the reference covers this via its sequential-model test with
+    num_workers > partitions (test_torch_sequential.py:23-54)."""
+    df = _make_df(session, n=1000, parts=2)
+    ds = from_frame(df)
+    assert ds.num_blocks() == 2
+    plans = ds.split_shards(world_size=5)
+    counts = [sum(n for _, _, n in plan) for plan in plans]
+    assert len(set(counts)) == 1  # equal share per rank
+    assert counts[0] == 1000 // 5
+    for plan in plans:
+        for block_idx, off, length in plan:
+            assert 0 <= block_idx < 2
+            assert off >= 0 and length > 0
+            assert off + length <= ds.block_sizes()[block_idx]
