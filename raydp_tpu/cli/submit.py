@@ -40,6 +40,11 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"])
     ap.add_argument("--conf", action="append", default=[], metavar="K=V",
                     help="config entry (repeatable), e.g. raydp.tpu.x=y")
+    ap.add_argument("--py-files", default=None, metavar="PATHS",
+                    help="comma-separated .py files, .zip archives or "
+                         "directories added to the driver's import path "
+                         "(parity: spark-submit --py-files through "
+                         "bin/raydp-submit)")
     ap.add_argument("--env", action="append", default=[], metavar="K=V",
                     help="extra environment for the script (repeatable)")
     ap.add_argument("script", help="python script to run")
@@ -73,6 +78,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     }
     env = dict(os.environ)
     env.update(_parse_kv(args.env, "--env"))
+    if args.py_files:
+        # .py files contribute their parent dir (a bare file path is not
+        # importable); zips and directories go on the path directly
+        entries = []
+        for raw in args.py_files.split(","):
+            raw = raw.strip()
+            if not raw:  # trailing/doubled comma must not resolve to cwd
+                continue
+            p = os.path.abspath(raw)
+            if not os.path.exists(p):
+                raise SystemExit(f"rdt-submit: --py-files entry not found: {p}")
+            entries.append(os.path.dirname(p) if p.endswith(".py") else p)
+        seen = dict.fromkeys(entries)  # dedupe, keep order
+        env["PYTHONPATH"] = os.pathsep.join(
+            list(seen) + [env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
     env[ENV_SUBMIT] = json.dumps(
         {k: v for k, v in submit.items() if v not in (None, {})})
 

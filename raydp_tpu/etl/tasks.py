@@ -382,19 +382,27 @@ class WindowStep(Step):
                 if self.order_keys:
                     # Spark's default frame WITH orderBy is unboundedPreceding
                     # ..currentRow — a RUNNING aggregate whose RANGE frame
-                    # includes order-key peers (ties share the value)
-                    nn_cum = series.notna().astype("int64") \
-                        .groupby(part_id).cumsum()
+                    # includes order-key peers (ties share the value). Nulls
+                    # are ignored within the frame (pandas cumulatives emit
+                    # NaN AT a null row while continuing past it — the
+                    # forward fill gives those rows the prior running value;
+                    # an all-null prefix correctly stays null)
+                    def _ffill(s):
+                        return s.groupby(part_id).ffill()
+
                     if fn == "sum":
-                        out_s = g.cumsum()
+                        out_s = _ffill(g.cumsum())
                     elif fn == "min":
-                        out_s = g.cummin()
+                        out_s = _ffill(g.cummin())
                     elif fn == "max":
-                        out_s = g.cummax()
+                        out_s = _ffill(g.cummax())
                     elif fn == "count":
-                        out_s = nn_cum
+                        out_s = series.notna().astype("int64") \
+                            .groupby(part_id).cumsum()
                     else:  # mean
-                        out_s = g.cumsum() / nn_cum
+                        nn_cum = series.notna().astype("int64") \
+                            .groupby(part_id).cumsum()
+                        out_s = _ffill(g.cumsum()) / nn_cum.where(nn_cum > 0)
                     out_s = pd.Series(self._range_frame(
                         out_s.to_numpy(), group_start, change_mask, n))
                 else:

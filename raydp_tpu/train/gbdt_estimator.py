@@ -75,15 +75,18 @@ class GBDTEstimator(EstimatorInterface, FrameEstimatorInterface):
         self.evals_result: Dict = {}
 
     # ------------------------------------------------------------------ data
+    def _feature_matrix(self, table) -> np.ndarray:
+        return np.stack([table.column(c).to_numpy(zero_copy_only=False)
+                         .astype(np.float32, copy=False)
+                         for c in self.feature_columns], axis=1)
+
     def _materialize(self, ds, with_weight: bool = False):
         if ds is None:
             return None
         if not self.feature_columns or self.label_column is None:
             raise ValueError("pass feature_columns and label_column")
         table = ds.to_arrow()
-        X = np.stack([table.column(c).to_numpy(zero_copy_only=False)
-                      .astype(np.float32, copy=False)
-                      for c in self.feature_columns], axis=1)
+        X = self._feature_matrix(table)
         y = (table.column(self.label_column).to_numpy(zero_copy_only=False)
              .astype(np.float32, copy=False))
         if with_weight and self.weight_column is not None:
@@ -160,6 +163,14 @@ class GBDTEstimator(EstimatorInterface, FrameEstimatorInterface):
         if self._model is None:
             raise RuntimeError("call fit()/fit_on_frame() first")
         return self._model
+
+    def predict(self, ds, output_margin: bool = False) -> np.ndarray:
+        """Run the fitted trees over a dataset's feature columns (the same
+        convenience FlaxEstimator.predict adds beyond the reference, whose
+        users rebuild an inference loop around ``get_model``)."""
+        model = self.get_model()
+        X = self._feature_matrix(ds.to_arrow())
+        return model.predict(X, output_margin=output_margin)
 
     @staticmethod
     def load_model(checkpoint_dir: str):

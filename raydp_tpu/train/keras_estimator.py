@@ -449,25 +449,40 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                 mvars = _mvars(tm_init)
                 loss_sum = jnp.zeros((), jnp.float32)
                 steps, samples = 0, 0
-                for item, k in feed.chained(chain):
+                t_feed = t_disp = 0.0
+                it = feed.chained(chain)
+                while True:
+                    tf = _time.perf_counter()
+                    nxt = next(it, None)
+                    t_feed += _time.perf_counter() - tf
+                    if nxt is None:
+                        break
+                    item, k = nxt
+                    td = _time.perf_counter()
                     if chain > 1:  # item is a [k, B, ...] stack, even at k=1
                         tv, ntv, ov, mvars, loss_sum = jit_chain(
                             tv, ntv, ov, mvars, loss_sum, item)
                     else:
                         tv, ntv, ov, mvars, loss_sum = jit_train(
                             tv, ntv, ov, mvars, loss_sum, item)
+                    t_disp += _time.perf_counter() - td
                     steps += k
                     samples += self.batch_size * k
                 # fetch the loss scalar BEFORE reading the clock: dispatch is
                 # async, so only a host fetch makes the epoch wall include
                 # the device work (stable across runs; see flax_estimator)
+                ts = _time.perf_counter()
                 loss_host = float(loss_sum) / steps if steps else float("nan")
+                t_sync = _time.perf_counter() - ts
                 dt = _time.perf_counter() - t0
                 report = {
                     "epoch": epoch,
                     "loss": loss_host,
                     "epoch_time_s": dt,
                     "samples_per_s": samples / dt if dt > 0 else 0.0,
+                    "feed_time_s": t_feed,
+                    "dispatch_time_s": t_disp,
+                    "sync_time_s": t_sync,
                 }
                 for m, mv in zip(train_metrics, mvars):
                     report[m.name] = float(m.stateless_result(list(mv)))

@@ -84,6 +84,18 @@ def test_estimator_fit_on_frame(session):
     loaded = GBDTEstimator.load_model(result.checkpoint_dir)
     np.testing.assert_allclose(loaded.predict(x[:5]), preds, rtol=1e-6)
 
+    # estimator-level batched inference over a dataset (mirrors
+    # FlaxEstimator.predict)
+    from raydp_tpu.data import from_frame
+
+    eval_ds = from_frame(eval_df)
+    ds_preds = est.predict(eval_ds)
+    assert ds_preds.shape == (eval_ds.count(),)
+    exp = model.predict(np.stack(
+        [eval_ds.to_arrow().column(c).to_numpy().astype(np.float32)
+         for c in ["f0", "f1", "f2"]], axis=1))
+    np.testing.assert_allclose(ds_preds, exp, rtol=1e-6)
+
 
 def test_multiclass_matches_sklearn_quality():
     """multi:softprob on 4-class blobs: accuracy within 3 points of sklearn's

@@ -61,3 +61,34 @@ def test_submit_missing_script(tmp_path):
     proc = _run(["/nonexistent/script.py"], cwd=str(tmp_path))
     assert proc.returncode != 0
     assert "not found" in proc.stderr
+
+
+def test_submit_py_files(tmp_path):
+    """--py-files makes sidecar modules importable in the submitted driver
+    (parity: the reference's raydp-submit --py-files examples,
+    examples/test_raydp_submit_pyfiles.py + test_pyfile.py)."""
+    lib_dir = tmp_path / "deps"
+    lib_dir.mkdir()
+    (lib_dir / "helper_mod.py").write_text("VALUE = 41\n")
+    extra = tmp_path / "single.py"
+    extra.write_text("OTHER = 1\n")
+
+    script = tmp_path / "job.py"
+    script.write_text(textwrap.dedent("""
+        import helper_mod
+        import single
+        print("SUM=%d" % (helper_mod.VALUE + single.OTHER))
+    """))
+    proc = _run(["--py-files", f"{lib_dir},{extra}", str(script)],
+                cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "SUM=42" in proc.stdout
+
+
+def test_submit_py_files_missing(tmp_path):
+    script = tmp_path / "job.py"
+    script.write_text("print('hi')\n")
+    proc = _run(["--py-files", "/nonexistent/dep.py", str(script)],
+                cwd=str(tmp_path))
+    assert proc.returncode != 0
+    assert "not found" in proc.stderr

@@ -196,3 +196,27 @@ def test_split_shards_fallback_shuffle_varies(session):
     for plans in (a, c):
         counts = [sum(n for _, _, n in p) for p in plans]
         assert counts == [200] * 5
+
+
+def test_running_aggregate_ignores_nulls(session):
+    """Spark ignores nulls inside the frame: a null row takes the prior
+    running value (not null), an all-null prefix stays null, and a null tie
+    peer does not poison the tie group (code-review r4 finding)."""
+    pdf = pd.DataFrame({
+        "k": [1, 1, 1, 2, 2, 2, 2],
+        "ts": [1, 2, 3, 1, 2, 2, 3],
+        "x": [None, None, 5.0, 10.0, None, 20.0, 30.0],
+    })
+    df = session.createDataFrame(pdf, num_partitions=2)
+    w = Window.partitionBy("k").orderBy("ts")
+    out = (df.withColumn("run", F.sum("x").over(w))
+             .withColumn("avg", F.mean("x").over(w))
+             .to_pandas().sort_values(["k", "ts", "x"], na_position="first")
+             .reset_index(drop=True))
+    k1 = out[out["k"] == 1]
+    assert pd.isna(k1["run"].iloc[0]) and pd.isna(k1["run"].iloc[1])
+    assert k1["run"].iloc[2] == 5.0
+    k2 = out[out["k"] == 2]["run"].tolist()
+    # ties at ts=2 (one null, one 20.0) both see 10+20=30
+    assert k2 == [10.0, 30.0, 30.0, 60.0]
+    assert out[out["k"] == 2]["avg"].tolist() == [10.0, 15.0, 15.0, 20.0]
