@@ -101,18 +101,25 @@ def _num_chips() -> int:
 
 
 def _probe_devices(timeout_s: Optional[float] = None) -> Optional[str]:
-    """What platform can a fresh process enumerate? Returns the platform name
-    ("tpu", "cpu", ...) or None when device init hangs. Runs in a subprocess
-    so a hung init cannot take this process with it. Note: the probe itself
-    briefly claims the chip, so never run bench concurrently with another TPU
-    job (which would be wrong anyway — one process owns the chip). Tune the
-    deadline with BENCH_TPU_PROBE_S.
+    """What platform can a fresh process actually COMPUTE on? Returns the
+    platform name ("tpu", "cpu", ...) or None when device init or a tiny
+    jitted matmul hangs. Enumeration alone is not enough: a wedged remote
+    tunnel has been observed to list the chip and then hang on the first
+    executable (r04), which would pass an enumerate-only probe and burn
+    every per-config wall cap. Runs in a subprocess so a hung init cannot
+    take this process with it. Note: the probe itself briefly claims the
+    chip, so never run bench concurrently with another TPU job (which would
+    be wrong anyway — one process owns the chip). Tune the deadline with
+    BENCH_TPU_PROBE_S.
     """
     if timeout_s is None:
         timeout_s = float(os.environ.get("BENCH_TPU_PROBE_S", "240"))
     proc = subprocess.Popen(
         [sys.executable, "-c",
-         "import jax; print('ok', jax.devices()[0].platform)"],
+         "import jax, jax.numpy as jnp; "
+         "x = jnp.ones((128, 128)); "
+         "jax.jit(lambda a: a @ a)(x).block_until_ready(); "
+         "print('ok', jax.devices()[0].platform)"],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         start_new_session=True)
     try:

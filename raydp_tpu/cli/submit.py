@@ -19,9 +19,11 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import signal
 import subprocess
 import sys
+import tempfile
 from typing import List, Optional
 
 ENV_SUBMIT = "RDT_SUBMIT_ARGS"
@@ -79,9 +81,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     env = dict(os.environ)
     env.update(_parse_kv(args.env, "--env"))
     if args.py_files:
-        # .py files contribute their parent dir (a bare file path is not
-        # importable); zips and directories go on the path directly
+        # Bare .py files are staged into one scratch dir and only that dir
+        # goes on the path — putting a file's parent dir up would expose
+        # every sibling module (and can shadow installed packages), which
+        # spark-submit's --py-files never does. Zips and directories go on
+        # the path directly.
         entries = []
+        stage_dir = None
         for raw in args.py_files.split(","):
             raw = raw.strip()
             if not raw:  # trailing/doubled comma must not resolve to cwd
@@ -89,7 +95,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             p = os.path.abspath(raw)
             if not os.path.exists(p):
                 raise SystemExit(f"rdt-submit: --py-files entry not found: {p}")
-            entries.append(os.path.dirname(p) if p.endswith(".py") else p)
+            if p.endswith(".py"):
+                if stage_dir is None:
+                    stage_dir = tempfile.mkdtemp(prefix="rdt-pyfiles-")
+                    entries.append(stage_dir)
+                shutil.copy2(p, stage_dir)
+            else:
+                entries.append(p)
         seen = dict.fromkeys(entries)  # dedupe, keep order
         env["PYTHONPATH"] = os.pathsep.join(
             list(seen) + [env.get("PYTHONPATH", "")]).rstrip(os.pathsep)

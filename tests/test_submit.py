@@ -70,19 +70,32 @@ def test_submit_py_files(tmp_path):
     lib_dir = tmp_path / "deps"
     lib_dir.mkdir()
     (lib_dir / "helper_mod.py").write_text("VALUE = 41\n")
-    extra = tmp_path / "single.py"
-    extra.write_text("OTHER = 1\n")
+    # the bare .py lives in a third directory (NOT the script's dir, which
+    # python puts on sys.path anyway) with a sibling that must NOT become
+    # importable: only the named file ships, as with spark-submit
+    other_dir = tmp_path / "elsewhere"
+    other_dir.mkdir()
+    (other_dir / "single.py").write_text("OTHER = 1\n")
+    (other_dir / "sibling_mod.py").write_text("LEAKED = True\n")
 
-    script = tmp_path / "job.py"
+    script_dir = tmp_path / "app"
+    script_dir.mkdir()
+    script = script_dir / "job.py"
     script.write_text(textwrap.dedent("""
         import helper_mod
         import single
+        try:
+            import sibling_mod
+            print("SIBLING_LEAKED")
+        except ImportError:
+            pass
         print("SUM=%d" % (helper_mod.VALUE + single.OTHER))
     """))
-    proc = _run(["--py-files", f"{lib_dir},{extra}", str(script)],
-                cwd=str(tmp_path))
+    proc = _run(["--py-files", f"{lib_dir},{other_dir / 'single.py'}",
+                 str(script)], cwd=str(tmp_path))
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "SUM=42" in proc.stdout
+    assert "SIBLING_LEAKED" not in proc.stdout
 
 
 def test_submit_py_files_missing(tmp_path):
