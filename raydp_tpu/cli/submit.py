@@ -80,6 +80,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     }
     env = dict(os.environ)
     env.update(_parse_kv(args.env, "--env"))
+    stage_dir = None
     if args.py_files:
         # Bare .py files are staged into one scratch dir and only that dir
         # goes on the path — putting a file's parent dir up would expose
@@ -87,21 +88,39 @@ def main(argv: Optional[List[str]] = None) -> int:
         # spark-submit's --py-files never does. Zips and directories go on
         # the path directly.
         entries = []
-        stage_dir = None
-        for raw in args.py_files.split(","):
-            raw = raw.strip()
-            if not raw:  # trailing/doubled comma must not resolve to cwd
-                continue
-            p = os.path.abspath(raw)
-            if not os.path.exists(p):
-                raise SystemExit(f"rdt-submit: --py-files entry not found: {p}")
-            if p.endswith(".py"):
-                if stage_dir is None:
-                    stage_dir = tempfile.mkdtemp(prefix="rdt-pyfiles-")
-                    entries.append(stage_dir)
-                shutil.copy2(p, stage_dir)
-            else:
-                entries.append(p)
+        staged = {}  # basename → source path; a silent overwrite would make
+        #              the LAST listed file win, inverting path precedence
+        try:
+            for raw in args.py_files.split(","):
+                raw = raw.strip()
+                if not raw:  # trailing/doubled comma must not resolve to cwd
+                    continue
+                p = os.path.abspath(raw)
+                if not os.path.exists(p):
+                    raise SystemExit(
+                        f"rdt-submit: --py-files entry not found: {p}")
+                if p.endswith(".py"):
+                    base = os.path.basename(p)
+                    prev = staged.get(base)
+                    if prev is not None and prev != p:
+                        raise SystemExit(
+                            f"rdt-submit: --py-files lists two files named "
+                            f"{base!r} ({prev} and {p}); module names must "
+                            "be unique")
+                    if stage_dir is None:
+                        stage_dir = tempfile.mkdtemp(prefix="rdt-pyfiles-")
+                        entries.append(stage_dir)
+                    staged[base] = p
+                    shutil.copy2(p, stage_dir)
+                else:
+                    entries.append(p)
+        except BaseException:
+            # a bad LATER entry must not leak the dir staged so far (the
+            # normal-path cleanup lives in the wait() finally below, which
+            # is never reached on a staging abort)
+            if stage_dir is not None:
+                shutil.rmtree(stage_dir, ignore_errors=True)
+            raise
         seen = dict.fromkeys(entries)  # dedupe, keep order
         env["PYTHONPATH"] = os.pathsep.join(
             list(seen) + [env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
@@ -125,6 +144,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     finally:
         for s, handler in old.items():
             signal.signal(s, handler)
+        if stage_dir is not None:
+            shutil.rmtree(stage_dir, ignore_errors=True)
 
 
 if __name__ == "__main__":

@@ -203,9 +203,17 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
         ckpt_dir = self.checkpoint_dir or tempfile.mkdtemp(
             prefix="rdt-keras-ckpt-")
         os.makedirs(ckpt_dir, exist_ok=True)
-        feed = DeviceFeed(train_ds, self.batch_size, columns, mesh=mesh,
-                          shuffle=self.shuffle, seed=self.seed,
-                          drop_remainder=self.drop_last)
+        # device-resident fast path (see feed.DeviceEpochCache): whole epoch
+        # in one dispatch, on-device shuffling — streaming feed otherwise
+        from raydp_tpu.data.feed import DeviceEpochCache
+        cache = feed = None
+        if DeviceEpochCache.eligible(train_ds, columns, self.batch_size,
+                                     self.drop_last):
+            cache = DeviceEpochCache(train_ds, columns, mesh=mesh)
+        if cache is None:
+            feed = DeviceFeed(train_ds, self.batch_size, columns, mesh=mesh,
+                              shuffle=self.shuffle, seed=self.seed,
+                              drop_remainder=self.drop_last)
         eval_feed = None
         if evaluate_ds is not None:
             dp_total = int(_np.prod([mesh.shape[a] for a in data_axes(mesh)]))
@@ -213,7 +221,8 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                                    mesh=mesh, shuffle=False,
                                    drop_remainder=dp_total > 1)
         model, history = self._stateless_train_loop(
-            mesh, feed, eval_feed, ckpt_dir, max_retries=max_retries)
+            mesh, feed, eval_feed, ckpt_dir, max_retries=max_retries,
+            cache=cache)
         self._trained_model = model
         self._result = TrainingResult(state=model, history=history,
                                       checkpoint_dir=ckpt_dir)
@@ -248,7 +257,8 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
         return out
 
     def _stateless_train_loop(self, mesh, feed, eval_feed, ckpt_dir: str,
-                              max_retries: int = 0, resume: bool = False):
+                              max_retries: int = 0, resume: bool = False,
+                              cache=None):
         """One jitted train step over stateless Keras calls; in-jit loss and
         metric accumulation; donated state buffers; chief-only per-epoch
         ``model.keras`` checkpoint with a JSON epoch/history sidecar.
@@ -316,7 +326,8 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                             chief_epoch)
 
         # build weights + optimizer slots from one sample batch's shapes
-        first = next(iter(feed.host_iter))
+        first = cache.init_row if cache is not None \
+            else next(iter(feed.host_iter))
         if not model.built:
             model.build(first["features"][:1].shape)
         optimizer.build(model.trainable_variables)
@@ -411,7 +422,7 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
 
         chain = self.steps_per_dispatch
         jit_chain = None
-        if chain > 1:
+        if chain > 1 and cache is None:
             from jax import lax
 
             def train_chain(tv, ntv, ov, mvars, loss_sum, batches):
@@ -423,6 +434,42 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                 return carry
 
             jit_chain = jax.jit(train_chain, donate_argnums=(0, 1, 2, 3, 4))
+
+        jit_epoch = None
+        cache_steps = 0
+        if cache is not None:
+            # device-resident epoch: one jitted scan slices batches out of
+            # the resident arrays on device, with per-epoch shuffling as an
+            # on-device permutation (see flax_estimator's twin of this path)
+            from jax import lax
+
+            from raydp_tpu.parallel.mesh import batch_sharding
+            b_sharding = batch_sharding(mesh)
+            B = self.batch_size
+            n_rows = cache.num_rows
+            cache_steps = n_rows // B
+            do_shuffle = self.shuffle
+
+            def train_epoch(tv, ntv, ov, mvars, loss_sum, data, ekey):
+                perm = jax.random.permutation(ekey, n_rows) \
+                    if do_shuffle else None
+
+                def body(carry, s):
+                    if perm is not None:
+                        idx = lax.dynamic_slice(perm, (s * B,), (B,))
+                        batch = {n: jnp.take(a, idx, axis=0)
+                                 for n, a in data.items()}
+                    else:
+                        batch = {n: lax.dynamic_slice_in_dim(a, s * B, B, 0)
+                                 for n, a in data.items()}
+                    batch = lax.with_sharding_constraint(batch, b_sharding)
+                    return train_step(*carry, batch), ()
+
+                carry, _ = lax.scan(body, (tv, ntv, ov, mvars, loss_sum),
+                                    jnp.arange(cache_steps))
+                return carry
+
+            jit_epoch = jax.jit(train_epoch, donate_argnums=(0, 1, 2, 3, 4))
 
         def _host_val(a):
             """Host copy of a replicated array (the local replica shard IS
@@ -445,29 +492,43 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
         while epoch < self.num_epochs:
             try:
                 t0 = _time.perf_counter()
-                feed.set_epoch(epoch)
                 mvars = _mvars(tm_init)
                 loss_sum = jnp.zeros((), jnp.float32)
                 steps, samples = 0, 0
                 t_feed = t_disp = 0.0
-                it = feed.chained(chain)
-                while True:
-                    tf = _time.perf_counter()
-                    nxt = next(it, None)
-                    t_feed += _time.perf_counter() - tf
-                    if nxt is None:
-                        break
-                    item, k = nxt
+                if cache is not None:
                     td = _time.perf_counter()
-                    if chain > 1:  # item is a [k, B, ...] stack, even at k=1
-                        tv, ntv, ov, mvars, loss_sum = jit_chain(
-                            tv, ntv, ov, mvars, loss_sum, item)
-                    else:
-                        tv, ntv, ov, mvars, loss_sum = jit_train(
-                            tv, ntv, ov, mvars, loss_sum, item)
-                    t_disp += _time.perf_counter() - td
-                    steps += k
-                    samples += self.batch_size * k
+                    ekey = jax.random.fold_in(
+                        jax.random.PRNGKey(self.seed), epoch)
+                    tv, ntv, ov, mvars, loss_sum = jit_epoch(
+                        tv, ntv, ov, mvars, loss_sum, cache.arrays, ekey)
+                    # fetch the loss scalar INSIDE this window: dispatch is
+                    # async, and dispatch_time_s must carry the epoch's
+                    # device time (see the flax twin)
+                    loss_sum = np.float32(loss_sum)
+                    t_disp = _time.perf_counter() - td
+                    steps = cache_steps
+                    samples = cache_steps * self.batch_size
+                else:
+                    feed.set_epoch(epoch)
+                    it = feed.chained(chain)
+                    while True:
+                        tf = _time.perf_counter()
+                        nxt = next(it, None)
+                        t_feed += _time.perf_counter() - tf
+                        if nxt is None:
+                            break
+                        item, k = nxt
+                        td = _time.perf_counter()
+                        if chain > 1:  # item is a [k, B, ...] stack, at k=1 too
+                            tv, ntv, ov, mvars, loss_sum = jit_chain(
+                                tv, ntv, ov, mvars, loss_sum, item)
+                        else:
+                            tv, ntv, ov, mvars, loss_sum = jit_train(
+                                tv, ntv, ov, mvars, loss_sum, item)
+                        t_disp += _time.perf_counter() - td
+                        steps += k
+                        samples += self.batch_size * k
                 # fetch the loss scalar BEFORE reading the clock: dispatch is
                 # async, so only a host fetch makes the epoch wall include
                 # the device work (stable across runs; see flax_estimator)

@@ -150,7 +150,7 @@ def test_estimator_sharded_batch(session):
     assert result.history[-1]["train_loss"] < result.history[0]["train_loss"]
 
 
-def test_steps_per_dispatch_chain_parity(session):
+def test_steps_per_dispatch_chain_parity(session, monkeypatch):
     """Chaining k train steps into one lax.scan dispatch must be numerically
     IDENTICAL to dispatching each batch: same update sequence, same loss
     history (the chain only amortizes host->device round trips). Also covers
@@ -162,6 +162,9 @@ def test_steps_per_dispatch_chain_parity(session):
 
     df = _linear_df(session, n=1344)  # 21 batches of 64 → 21 % 4 != 0
     ds = from_frame(df)
+    # pin the STREAMING feed: the device-resident path neither chains nor
+    # streams, which would make this parity check vacuous
+    monkeypatch.setenv("RDT_DEVICE_CACHE", "0")
 
     def run(chain):
         est = FlaxEstimator(
@@ -217,3 +220,92 @@ def test_steps_per_dispatch_ragged_tail(session):
     result = est.fit(ds)
     assert [r["steps"] for r in result.history] == [22, 22]
     assert np.isfinite(result.history[-1]["train_loss"])
+
+
+def test_device_cache_parity_and_fallback(session, monkeypatch):
+    """The device-resident epoch path (whole epoch = one jitted scan over
+    HBM-pinned arrays) must produce exactly the streaming feed's update
+    sequence at shuffle=False — same batches, same order — and the
+    ``RDT_DEVICE_CACHE`` / budget knobs must force the streaming fallback."""
+    import optax
+
+    from raydp_tpu.data import from_frame
+
+    df = _linear_df(session, n=1344)
+    ds = from_frame(df)
+    # pin the knobs: ambient RDT_DEVICE_CACHE*=... (e.g. exported while
+    # debugging the streaming path) must not flip the first run
+    monkeypatch.setenv("RDT_DEVICE_CACHE", "1")
+    monkeypatch.delenv("RDT_DEVICE_CACHE_MB", raising=False)
+
+    def run():
+        est = FlaxEstimator(
+            model=MLP(features=(8,), use_batch_norm=True),
+            optimizer=optax.adam(1e-2),
+            loss="mse",
+            feature_columns=["x1", "x2"],
+            label_column="y",
+            batch_size=64,
+            num_epochs=2,
+            shuffle=False,
+            seed=0,
+        )
+        return est.fit(ds)
+
+    resident = run()
+    # the resident path does no host-side feeding at all
+    assert all(r["feed_time_s"] == 0.0 for r in resident.history)
+
+    monkeypatch.setenv("RDT_DEVICE_CACHE", "0")
+    streamed = run()
+    assert any(r["feed_time_s"] > 0.0 for r in streamed.history)
+
+    assert [r["steps"] for r in resident.history] == \
+        [r["steps"] for r in streamed.history]
+    for a, b in zip(resident.history, streamed.history):
+        np.testing.assert_allclose(a["train_loss"], b["train_loss"],
+                                   rtol=1e-5, atol=1e-6)
+
+    # a zero budget must also fall back (estimate > cap)
+    monkeypatch.setenv("RDT_DEVICE_CACHE", "1")
+    monkeypatch.setenv("RDT_DEVICE_CACHE_MB", "0")
+    capped = run()
+    assert any(r["feed_time_s"] > 0.0 for r in capped.history)
+
+
+def test_device_cache_shuffled_training_converges(session):
+    """With shuffle=True the resident path shuffles via an on-device
+    permutation per epoch: training must still converge on the linear task
+    and walk a different batch order every epoch (loss histories differ from
+    an unshuffled run)."""
+    import optax
+
+    from raydp_tpu.data import from_frame
+
+    df = _linear_df(session, n=1344)
+    ds = from_frame(df)
+
+    def run(shuffle):
+        est = FlaxEstimator(
+            model=MLP(features=(16,), use_batch_norm=False),
+            optimizer=optax.adam(1e-2),
+            loss="mse",
+            feature_columns=["x1", "x2"],
+            label_column="y",
+            batch_size=64,
+            num_epochs=4,
+            shuffle=shuffle,
+            seed=0,
+        )
+        return est.fit(ds)
+
+    result = run(True)
+    assert all(r["feed_time_s"] == 0.0 for r in result.history)
+    assert result.history[-1]["train_loss"] < result.history[0]["train_loss"]
+
+    # the permutation must actually reorder rows: an unshuffled twin walks a
+    # different batch sequence, so its loss history cannot coincide
+    unshuffled = run(False)
+    assert any(
+        abs(a["train_loss"] - b["train_loss"]) > 1e-9
+        for a, b in zip(result.history, unshuffled.history))
