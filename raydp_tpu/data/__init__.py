@@ -9,6 +9,7 @@ balanced per-rank sharding kernel (utils.py:149-222). The TPU-specific tail is
 ``NamedSharding`` so batches land already sharded over the mesh's data axis.
 """
 
+from raydp_tpu.data.bridges import to_tf_dataset, to_torch_dataset
 from raydp_tpu.data.dataset import (
     DistributedDataset,
     from_frame,
@@ -16,7 +17,7 @@ from raydp_tpu.data.dataset import (
     release,
     to_frame,
 )
-from raydp_tpu.data.feed import DeviceFeed, ShardSpec
+from raydp_tpu.data.feed import DeviceEpochCache, DeviceFeed, ShardSpec
 
 __all__ = [
     "DistributedDataset",
@@ -24,6 +25,9 @@ __all__ = [
     "from_frame_recoverable",
     "release",
     "to_frame",
+    "DeviceEpochCache",
     "DeviceFeed",
     "ShardSpec",
+    "to_torch_dataset",
+    "to_tf_dataset",
 ]

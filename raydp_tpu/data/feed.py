@@ -354,6 +354,49 @@ class DeviceEpochCache:
         # are dropped once resident on device
         self.init_row = {n: a[:1].copy() for n, a in joined.items()}
 
+    def make_epoch_fn(self, step, batch_size: int, shuffle: bool,
+                      batch_sharding=None):
+        """Build THE resident epoch program both estimators jit — one source
+        for the permutation/slice/constraint/scan logic so the flax and keras
+        twins cannot drift.
+
+        ``step(carry, batch) -> carry`` is the caller's train step in scan
+        form. Returns ``(epoch_fn, steps_per_epoch)`` with
+        ``epoch_fn(carry, data, key) -> carry``: one whole epoch —
+        per-epoch on-device permutation when ``shuffle`` (a true uniform row
+        shuffle), batches sliced/gathered on device, each constrained onto
+        the mesh's batch sharding. Callers jit it with the carry donated and
+        ``data``/``key`` left alone (the resident arrays are reused every
+        epoch).
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        n_rows, B = self.num_rows, batch_size
+        steps_per_epoch = n_rows // B
+
+        def epoch_fn(carry, data, key):
+            perm = jax.random.permutation(key, n_rows) if shuffle else None
+
+            def body(carry, s):
+                if perm is not None:
+                    idx = lax.dynamic_slice(perm, (s * B,), (B,))
+                    batch = {n: jnp.take(a, idx, axis=0)
+                             for n, a in data.items()}
+                else:
+                    batch = {n: lax.dynamic_slice_in_dim(a, s * B, B, 0)
+                             for n, a in data.items()}
+                if batch_sharding is not None:
+                    batch = lax.with_sharding_constraint(batch,
+                                                         batch_sharding)
+                return step(carry, batch), ()
+
+            carry, _ = lax.scan(body, carry, jnp.arange(steps_per_epoch))
+            return carry
+
+        return epoch_fn, steps_per_epoch
+
     @staticmethod
     def cap_bytes() -> int:
         return int(float(os.environ.get("RDT_DEVICE_CACHE_MB", "2048"))

@@ -356,44 +356,18 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         jit_epoch = None
         cache_steps = 0
         if cache is not None:
-            # device-resident path: the WHOLE epoch is one jitted scan whose
-            # body slices batches out of the resident arrays on device —
-            # shuffling is an on-device permutation (a true uniform row
-            # shuffle, subsuming the dataset-level random_shuffle +
-            # within-block permutation of the streaming path). Steady-state
+            # device-resident path: the WHOLE epoch is one jitted dispatch
+            # (the shared scan program built by DeviceEpochCache — one source
+            # for the permutation/slice logic across estimators). Steady-state
             # host work per epoch: one dispatch + one scalar fetch.
-            from jax import lax
+            def _step(carry, batch):
+                state, loss_sum, mstats = carry
+                return train_step(state, batch, mstats, loss_sum)
 
-            B = self.batch_size
-            cache_steps = cache.num_rows // B
-            do_shuffle = self.shuffle
-            n_rows = cache.num_rows
-
-            def train_epoch(state, data, epoch_key, mstats, loss_sum):
-                perm = jax.random.permutation(epoch_key, n_rows) \
-                    if do_shuffle else None
-
-                def body(carry, s):
-                    state, loss_sum, mstats = carry
-                    if perm is not None:
-                        idx = lax.dynamic_slice(perm, (s * B,), (B,))
-                        batch = {n: jnp.take(a, idx, axis=0)
-                                 for n, a in data.items()}
-                    else:
-                        batch = {n: lax.dynamic_slice_in_dim(a, s * B, B, 0)
-                                 for n, a in data.items()}
-                    if b_sharding is not None:
-                        batch = lax.with_sharding_constraint(batch, b_sharding)
-                    state, loss_sum, mstats = train_step(
-                        state, batch, mstats, loss_sum)
-                    return (state, loss_sum, mstats), ()
-
-                (state, loss_sum, mstats), _ = lax.scan(
-                    body, (state, loss_sum, mstats),
-                    jnp.arange(cache_steps))
-                return state, loss_sum, mstats
-
-            jit_epoch = jax.jit(train_epoch, donate_argnums=(0, 3, 4))
+            epoch_fn, cache_steps = cache.make_epoch_fn(
+                _step, self.batch_size, self.shuffle,
+                batch_sharding=b_sharding)
+            jit_epoch = jax.jit(epoch_fn, donate_argnums=(0,))
 
         history: List[Dict[str, float]] = []
         epoch = 0
@@ -421,7 +395,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                     ekey = jax.random.fold_in(
                         jax.random.PRNGKey(self.seed), epoch)
                     state, loss_sum, mstats = jit_epoch(
-                        state, cache.arrays, ekey, mstats, loss_sum)
+                        (state, loss_sum, mstats), cache.arrays, ekey)
                     # dispatch is async: fetch the loss scalar INSIDE this
                     # window so dispatch_time_s carries the epoch's device
                     # time (otherwise the report's sync slot absorbs it and

@@ -438,38 +438,16 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
         jit_epoch = None
         cache_steps = 0
         if cache is not None:
-            # device-resident epoch: one jitted scan slices batches out of
-            # the resident arrays on device, with per-epoch shuffling as an
-            # on-device permutation (see flax_estimator's twin of this path)
-            from jax import lax
-
+            # device-resident epoch: the shared scan program built by
+            # DeviceEpochCache (one source for the permutation/slice logic
+            # across estimators; see the flax twin)
             from raydp_tpu.parallel.mesh import batch_sharding
-            b_sharding = batch_sharding(mesh)
-            B = self.batch_size
-            n_rows = cache.num_rows
-            cache_steps = n_rows // B
-            do_shuffle = self.shuffle
 
-            def train_epoch(tv, ntv, ov, mvars, loss_sum, data, ekey):
-                perm = jax.random.permutation(ekey, n_rows) \
-                    if do_shuffle else None
-
-                def body(carry, s):
-                    if perm is not None:
-                        idx = lax.dynamic_slice(perm, (s * B,), (B,))
-                        batch = {n: jnp.take(a, idx, axis=0)
-                                 for n, a in data.items()}
-                    else:
-                        batch = {n: lax.dynamic_slice_in_dim(a, s * B, B, 0)
-                                 for n, a in data.items()}
-                    batch = lax.with_sharding_constraint(batch, b_sharding)
-                    return train_step(*carry, batch), ()
-
-                carry, _ = lax.scan(body, (tv, ntv, ov, mvars, loss_sum),
-                                    jnp.arange(cache_steps))
-                return carry
-
-            jit_epoch = jax.jit(train_epoch, donate_argnums=(0, 1, 2, 3, 4))
+            epoch_fn, cache_steps = cache.make_epoch_fn(
+                lambda carry, batch: train_step(*carry, batch),
+                self.batch_size, self.shuffle,
+                batch_sharding=batch_sharding(mesh))
+            jit_epoch = jax.jit(epoch_fn, donate_argnums=(0,))
 
         def _host_val(a):
             """Host copy of a replicated array (the local replica shard IS
@@ -501,7 +479,7 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                     ekey = jax.random.fold_in(
                         jax.random.PRNGKey(self.seed), epoch)
                     tv, ntv, ov, mvars, loss_sum = jit_epoch(
-                        tv, ntv, ov, mvars, loss_sum, cache.arrays, ekey)
+                        (tv, ntv, ov, mvars, loss_sum), cache.arrays, ekey)
                     # fetch the loss scalar INSIDE this window: dispatch is
                     # async, and dispatch_time_s must carry the epoch's
                     # device time (see the flax twin)

@@ -192,3 +192,73 @@ def test_split_shards_more_ranks_than_blocks(session):
             assert 0 <= block_idx < 2
             assert off >= 0 and length > 0
             assert off + length <= ds.block_sizes()[block_idx]
+
+
+def test_to_torch_dataset_bridge(session):
+    """The torch bridge (reference TorchMLDataset parity,
+    torch_ml_dataset.py:30-67): batched (features, label) CPU tensors over
+    the native host feed, len() in batches, shard selection for DDP ranks."""
+    import torch
+
+    from raydp_tpu.data import to_torch_dataset
+
+    ds = from_frame(_make_df(session, n=500, parts=2))
+    tds = to_torch_dataset(ds, feature_columns=["x", "y"], label_column="id",
+                           batch_size=100, label_dtype=np.int64)
+    assert len(tds) == 5
+    batches = list(tds)
+    assert len(batches) == 5
+    feats, labels = batches[0]
+    assert isinstance(feats, torch.Tensor) and feats.shape == (100, 2)
+    assert labels.dtype == torch.int64 and labels.shape == (100,)
+    total = torch.cat([b[1] for b in batches])
+    assert sorted(total.tolist()) == list(range(500))
+
+    # per-rank shards partition the rows
+    r0 = to_torch_dataset(ds, ["x"], "id", batch_size=50,
+                          label_dtype=np.int64, world_size=2, rank=0)
+    r1 = to_torch_dataset(ds, ["x"], "id", batch_size=50,
+                          label_dtype=np.int64, world_size=2, rank=1)
+    ids0 = torch.cat([b[1] for b in r0]).tolist()
+    ids1 = torch.cat([b[1] for b in r1]).tolist()
+    assert len(ids0) == len(ids1) == 250
+    assert not set(ids0) & set(ids1)
+
+    # a stock DataLoader consumes it with batch_size=None (pre-batched)
+    loader = torch.utils.data.DataLoader(tds, batch_size=None)
+    first = next(iter(loader))
+    assert first[0].shape == (100, 2)
+
+    # shuffle=True must walk a DIFFERENT batch order each epoch (the
+    # external-loop analogue of DeviceFeed.set_epoch)
+    sds = to_torch_dataset(ds, ["x"], "id", batch_size=100,
+                           label_dtype=np.int64, shuffle=True, seed=7)
+    e0 = torch.cat([b[1] for b in sds]).tolist()
+    e1 = torch.cat([b[1] for b in sds]).tolist()
+    assert sorted(e0) == sorted(e1) == list(range(500))
+    assert e0 != e1
+
+    # num_workers=2: the stripe split must yield each batch exactly once
+    # per epoch (not once per worker)
+    wloader = torch.utils.data.DataLoader(tds, batch_size=None,
+                                          num_workers=2)
+    ids = torch.cat([b[1] for b in wloader]).tolist()
+    assert sorted(ids) == list(range(500))
+
+
+def test_to_tf_dataset_bridge(session):
+    """The tf.data bridge (reference to_tf parity, tf/estimator.py:179-199):
+    batched (features, label) tensors, ragged tail declared in the
+    signature."""
+    import tensorflow as tf
+
+    from raydp_tpu.data import to_tf_dataset
+
+    ds = from_frame(_make_df(session, n=250, parts=2))
+    tfds = to_tf_dataset(ds, feature_columns=["x", "y"], label_column="id",
+                         batch_size=100, label_dtype=np.int64)
+    batches = list(tfds)
+    assert [int(b[0].shape[0]) for b in batches] == [100, 100, 50]
+    assert batches[0][0].dtype == tf.float32
+    ids = np.concatenate([b[1].numpy() for b in batches])
+    assert sorted(ids.tolist()) == list(range(250))

@@ -127,11 +127,13 @@ def test_keras_fit_gang_matches_single_process(session, tmp_path):
     assert preds.shape == (1, 1)
 
 
-def test_keras_steps_per_dispatch_chain_parity(session):
+def test_keras_steps_per_dispatch_chain_parity(session, monkeypatch):
     """Chained dispatch (lax.scan over k stacked batches) must produce the
     same loss history as per-batch dispatch — same update sequence, fewer
     host round trips (mirrors the FlaxEstimator chain-parity test)."""
     df = _make_frame(session, n=448)  # 7 batches of 64 → 7 % 3 != 0
+    # pin the STREAMING feed — the resident path neither chains nor streams
+    monkeypatch.setenv("RDT_DEVICE_CACHE", "0")
 
     def run(chain):
         from raydp_tpu.data import from_frame
@@ -142,4 +144,26 @@ def test_keras_steps_per_dispatch_chain_parity(session):
     plain = run(1)
     chained = run(3)
     for a, b in zip(plain.history, chained.history):
+        np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5, atol=1e-6)
+
+
+def test_keras_device_cache_parity(session, monkeypatch):
+    """The device-resident epoch path must walk exactly the streaming feed's
+    update sequence at shuffle=False (mirrors the FlaxEstimator resident
+    parity test, on the keras stateless loop)."""
+    df = _make_frame(session, n=448)
+    monkeypatch.setenv("RDT_DEVICE_CACHE", "1")
+    monkeypatch.delenv("RDT_DEVICE_CACHE_MB", raising=False)
+
+    def run():
+        from raydp_tpu.data import from_frame
+        est = _estimator(num_epochs=2, shuffle=False)
+        return est.fit(from_frame(df))
+
+    resident = run()
+    assert all(r["feed_time_s"] == 0.0 for r in resident.history)
+    monkeypatch.setenv("RDT_DEVICE_CACHE", "0")
+    streamed = run()
+    assert any(r["feed_time_s"] > 0.0 for r in streamed.history)
+    for a, b in zip(resident.history, streamed.history):
         np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5, atol=1e-6)

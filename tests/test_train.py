@@ -273,7 +273,7 @@ def test_device_cache_parity_and_fallback(session, monkeypatch):
     assert any(r["feed_time_s"] > 0.0 for r in capped.history)
 
 
-def test_device_cache_shuffled_training_converges(session):
+def test_device_cache_shuffled_training_converges(session, monkeypatch):
     """With shuffle=True the resident path shuffles via an on-device
     permutation per epoch: training must still converge on the linear task
     and walk a different batch order every epoch (loss histories differ from
@@ -284,6 +284,8 @@ def test_device_cache_shuffled_training_converges(session):
 
     df = _linear_df(session, n=1344)
     ds = from_frame(df)
+    monkeypatch.setenv("RDT_DEVICE_CACHE", "1")
+    monkeypatch.delenv("RDT_DEVICE_CACHE_MB", raising=False)
 
     def run(shuffle):
         est = FlaxEstimator(
