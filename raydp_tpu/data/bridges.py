@@ -20,7 +20,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from raydp_tpu.data.feed import HostBatchIterator, ShardSpec
+from raydp_tpu.data.feed import HostBatchIterator, ShardSpec, epoch_seed
 
 __all__ = ["to_torch_dataset", "to_tf_dataset"]
 
@@ -85,34 +85,42 @@ def to_torch_dataset(ds, feature_columns: Sequence[str],
             info = get_worker_info()
             # per-epoch reseed — the external-loop analogue of
             # DeviceFeed.set_epoch; without it every epoch replays
-            # byte-identical batch order. Single-process: __iter__ runs once
-            # per epoch, count locally. num_workers>0: workers are FORKED
-            # per epoch (the parent's counter never advances in them), so
-            # derive the epoch signal from the DataLoader's per-epoch base
-            # seed instead — info.seed - info.id is epoch-varying and
-            # identical across workers, which the stripe split below needs.
-            if info is None:
-                epoch_sig, self._epoch = self._epoch, self._epoch + 1
-            else:
-                epoch_sig = int(info.seed) - int(info.id)
-            it_seed = (seed + epoch_sig * 1000003) % (2**31 - 1) \
-                if shuffle else seed
+            # byte-identical batch order. The epoch signal must vary per
+            # epoch and be IDENTICAL across loader workers (the stripe split
+            # below needs all workers walking one order). Two worker modes:
+            # fresh forks per epoch (counter resets, but the DataLoader's
+            # per-epoch base seed info.seed - info.id varies) and
+            # persistent_workers (base seed fixed, but this dataset copy
+            # lives on and its counter advances) — the SUM covers both.
+            epoch_sig, self._epoch = self._epoch, self._epoch + 1
+            if info is not None:
+                epoch_sig += int(info.seed) - int(info.id)
+            it_seed = epoch_seed(seed, epoch_sig) if shuffle else seed
             it = HostBatchIterator(
                 ds, batch_size, columns, shard=shard, shuffle=shuffle,
                 seed=it_seed, drop_remainder=drop_last)
+
+            def _tensor(a):
+                # the host feed serves read-only views of its frozen decode
+                # cache; from_numpy would share that memory and let an
+                # in-place consumer mutation (feats.sub_(...)) silently
+                # poison later epochs — copy unless already writeable-owned
+                a = np.ascontiguousarray(a)
+                if not a.flags.writeable:
+                    a = a.copy()
+                return torch.from_numpy(a)
+
             # every worker walks the SAME order and takes every N-th batch
             # (a stripe split): without it each of N workers would yield the
             # whole dataset, N× data per epoch
             for i, batch in enumerate(it):
                 if info is not None and i % info.num_workers != info.id:
                     continue
-                feats = torch.from_numpy(np.ascontiguousarray(
-                    batch["features"]))
+                feats = _tensor(batch["features"])
                 if label_column is None:
                     yield feats
                 else:
-                    yield feats, torch.from_numpy(np.ascontiguousarray(
-                        batch["label"]))
+                    yield feats, _tensor(batch["label"])
 
         def __len__(self):
             return n_batches
@@ -159,7 +167,7 @@ def to_tf_dataset(ds, feature_columns: Sequence[str],
         # from_generator re-invokes this per epoch (model.fit / .repeat()):
         # vary the shuffle seed each time, like DeviceFeed.set_epoch
         epoch, epoch_box[0] = epoch_box[0], epoch_box[0] + 1
-        it_seed = (seed + epoch * 1000003) % (2**31 - 1) if shuffle else seed
+        it_seed = epoch_seed(seed, epoch) if shuffle else seed
         it = HostBatchIterator(ds, batch_size, columns, shard=shard,
                                shuffle=shuffle, seed=it_seed,
                                drop_remainder=drop_last)

@@ -45,6 +45,13 @@ class ShardSpec:
 ColumnSpec = Union[str, Sequence[str]]
 
 
+def epoch_seed(base: int, epoch: int) -> int:
+    """Deterministic per-epoch shuffle seed — THE derivation every feed path
+    shares (DeviceFeed.set_epoch and both external-loop bridges), so the
+    bridges cannot drift from the native data-plane semantics."""
+    return (base + epoch * 1000003) % (2**31 - 1)
+
+
 def _normalize_columns(columns: Dict[str, Tuple[ColumnSpec, np.dtype]]
                        ) -> Dict[str, Tuple[Tuple[str, ...], np.dtype]]:
     return {
@@ -482,7 +489,7 @@ class DeviceFeed:
         """Reseed per-epoch so shuffling differs across epochs deterministically."""
         if not hasattr(self, "_base_seed"):
             self._base_seed = self.host_iter.seed
-        self.host_iter.seed = (self._base_seed + (epoch + 1) * 1000003) % (2**31 - 1)
+        self.host_iter.seed = epoch_seed(self._base_seed, epoch + 1)
 
     def _place(self, batch: Dict[str, np.ndarray], sharding=None):
         jax = self._jax

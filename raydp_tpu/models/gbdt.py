@@ -174,6 +174,36 @@ def _build_tree(Xb, g, h, *, max_depth: int, num_bins: int,
     return split_feature, split_bin, leaf_value, leaf_value[node]
 
 
+def _boost_round(Xb, y, w, pred, build, objective: str):
+    """ONE boosting round — the single copy of the per-round tree math every
+    scan body shares (g/h weighting, the multiclass vmap, the margin
+    update): returns ``(new_pred, (sf, sb, lv))``."""
+    g, h = _grad_hess(pred, y, objective)
+    if g.ndim == 2:  # multiclass: K trees via vmap over the class axis
+        g = g * w[:, None]
+        h = h * w[:, None]
+        sf, sb, lv, upd = jax.vmap(
+            lambda gk, hk: build(Xb, gk, hk),
+            in_axes=1, out_axes=0)(g, h)     # tables [K, ...], upd [K, n]
+        return pred + upd.T, (sf, sb, lv)
+    sf, sb, lv, upd = build(Xb, g * w, h * w)
+    return pred + upd, (sf, sb, lv)
+
+
+def _route(Xb, sf, sb, leaves, max_depth: int):
+    """Route every row of a binned matrix through one tree — the single
+    routing walk (also the in-scan eval predictor)."""
+    n = Xb.shape[0]
+    rows = jnp.arange(n)
+    node = jnp.zeros(n, dtype=jnp.int32)
+    for depth in range(max_depth):
+        offset = 2 ** depth - 1
+        feat = sf[offset + node]
+        thr = sb[offset + node]
+        node = node * 2 + (Xb[rows, feat] > thr).astype(jnp.int32)
+    return leaves[node]
+
+
 @partial(jax.jit, static_argnames=(
     "chunk", "max_depth", "num_bins", "objective"))
 def _boost_chunk(Xb, y, w, pred, *, chunk: int, max_depth: int, num_bins: int,
@@ -189,36 +219,66 @@ def _boost_chunk(Xb, y, w, pred, *, chunk: int, max_depth: int, num_bins: int,
                     min_child_weight=min_child_weight)
 
     def boost(pred, _):
-        g, h = _grad_hess(pred, y, objective)
-        if g.ndim == 2:  # multiclass: K trees via vmap over the class axis
-            g = g * w[:, None]
-            h = h * w[:, None]
-            sf, sb, lv, upd = jax.vmap(
-                lambda gk, hk: build(Xb, gk, hk),
-                in_axes=1, out_axes=0)(g, h)     # tables [K, ...], upd [K, n]
-            return pred + upd.T, (sf, sb, lv)
-        sf, sb, lv, upd = build(Xb, g * w, h * w)
-        return pred + upd, (sf, sb, lv)
+        return _boost_round(Xb, y, w, pred, build, objective)
 
     pred, trees = jax.lax.scan(boost, pred, None, length=chunk)
     return trees, pred
+
+
+def _eval_metric_value(margin, y, objective: str):
+    """In-jit twin of :func:`eval_metric`'s value (same formulas, jnp ops) —
+    what the fused train+eval scan accumulates per round."""
+    if objective == "binary:logistic":
+        p = 1.0 / (1.0 + jnp.exp(-margin))
+        eps = 1e-7
+        return -jnp.mean(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps))
+    if objective.startswith("multi:"):
+        e = jnp.exp(margin - margin.max(axis=1, keepdims=True))
+        p = e / e.sum(axis=1, keepdims=True)
+        rows = jnp.arange(y.shape[0])
+        return -jnp.mean(jnp.log(p[rows, y.astype(jnp.int32)] + 1e-7))
+    return jnp.sqrt(jnp.mean((margin - y) ** 2))
+
+
+@partial(jax.jit, static_argnames=(
+    "chunk", "max_depth", "num_bins", "objective"))
+def _boost_chunk_eval(Xb, y, w, pred, eXb, ey, eval_margin, *, chunk: int,
+                      max_depth: int, num_bins: int, learning_rate: float,
+                      reg_lambda: float, min_child_weight: float,
+                      objective: str):
+    """``chunk`` rounds with the per-round eval-set metric computed ON
+    DEVICE: one dispatch covers the whole train+eval history. The host
+    per-round loop this replaces (still used for early stopping, whose
+    keep/stop decision is host semantics) paid a tree-table fetch plus an
+    eval dispatch every round — dominant on a remote-tunnel backend."""
+    build = partial(_build_tree, max_depth=max_depth, num_bins=num_bins,
+                    learning_rate=learning_rate, reg_lambda=reg_lambda,
+                    min_child_weight=min_child_weight)
+
+    def boost(carry, _):
+        pred, emargin = carry
+        pred, (sf, sb, lv) = _boost_round(Xb, y, w, pred, build, objective)
+        if sf.ndim == 2:  # multiclass: [K, nodes] tables → [en, K] margins
+            emargin = emargin + jax.vmap(
+                lambda s, b, l: _route(eXb, s, b, l, max_depth))(
+                    sf, sb, lv).T
+        else:
+            emargin = emargin + _route(eXb, sf, sb, lv, max_depth)
+        value = _eval_metric_value(emargin, ey, objective)
+        return (pred, emargin), (sf, sb, lv, value)
+
+    (pred, _), (sf, sb, lv, values) = jax.lax.scan(
+        boost, (pred, eval_margin), None, length=chunk)
+    return (sf, sb, lv), pred, values
 
 
 @partial(jax.jit, static_argnames=("max_depth",))
 def _predict_binned_jit(Xb, split_feature, split_bin, leaf_value,
                         max_depth: int):
     n = Xb.shape[0]
-    rows = jnp.arange(n)
 
     def route(sf, sb, leaves):
-        node = jnp.zeros(n, dtype=jnp.int32)
-        for depth in range(max_depth):
-            offset = 2 ** depth - 1
-            feat = sf[offset + node]
-            thr = sb[offset + node]
-            go_right = Xb[rows, feat] > thr
-            node = node * 2 + go_right.astype(jnp.int32)
-        return leaves[node]
+        return _route(Xb, sf, sb, leaves, max_depth)
 
     def one_tree(pred, tree):
         sf, sb, leaves = tree
@@ -369,31 +429,42 @@ def fit_gbdt(
         metric_name = eval_metric(eval_margin, ey, objective)[0]
         history: List[float] = []
         best, best_round = np.inf, -1
-        for rnd in range(num_trees):
-            trees, pred = _boost_chunk(Xb_j, y_j, w_j, pred, chunk=1, **kwargs)
-            chunk_tables = tuple(np.asarray(t) for t in trees)
-            parts.append(chunk_tables)
-            eval_margin = eval_margin + predict_binned(
-                eXb, *chunk_tables, max_depth)
-            _, value = eval_metric(eval_margin, ey, objective)
-            history.append(value)
-            if value < best - 1e-12:
-                best, best_round = value, rnd
-            if (early_stopping_rounds is not None
-                    and rnd - best_round >= early_stopping_rounds):
-                break
-        evals_result = {f"eval_{metric_name}": history}
-        # a metric that never improves (NaN/inf) leaves best_round at -1:
-        # keep at least the first round rather than an empty forest
-        best_round = max(best_round, 0)
-        keep = (best_round + 1) if early_stopping_rounds is not None \
-            else len(parts)
-        tables = [np.concatenate([p[i] for p in parts[:keep]], axis=0)
-                  for i in range(3)]
-        best_iteration = best_round if early_stopping_rounds is not None \
-            else None
-        if keep < len(parts):  # truncated: train margins must match the kept forest
-            pred = base_score + predict_binned(Xb, *tables, max_depth)
+        if early_stopping_rounds is None:
+            # no host decisions between rounds: fuse training AND the
+            # per-round eval into one device scan — one dispatch total
+            trees, pred, values = _boost_chunk_eval(
+                Xb_j, y_j, w_j, pred, jnp.asarray(eXb), jnp.asarray(ey),
+                jnp.asarray(eval_margin), chunk=num_trees, **kwargs)
+            tables = [np.asarray(t) for t in trees]
+            history = [float(v) for v in np.asarray(values)]
+            evals_result = {f"eval_{metric_name}": history}
+            best_iteration = None
+        else:
+            # early stopping: the keep/stop decision is host semantics —
+            # round-at-a-time with host metric checks
+            for rnd in range(num_trees):
+                trees, pred = _boost_chunk(Xb_j, y_j, w_j, pred, chunk=1,
+                                           **kwargs)
+                chunk_tables = tuple(np.asarray(t) for t in trees)
+                parts.append(chunk_tables)
+                eval_margin = eval_margin + predict_binned(
+                    eXb, *chunk_tables, max_depth)
+                _, value = eval_metric(eval_margin, ey, objective)
+                history.append(value)
+                if value < best - 1e-12:
+                    best, best_round = value, rnd
+                if rnd - best_round >= early_stopping_rounds:
+                    break
+            evals_result = {f"eval_{metric_name}": history}
+            # a metric that never improves (NaN/inf) leaves best_round at -1:
+            # keep at least the first round rather than an empty forest
+            best_round = max(best_round, 0)
+            keep = best_round + 1
+            tables = [np.concatenate([p[i] for p in parts[:keep]], axis=0)
+                      for i in range(3)]
+            best_iteration = best_round
+            if keep < len(parts):  # truncated: train margins must match
+                pred = base_score + predict_binned(Xb, *tables, max_depth)
 
     model = GBDTModel(split_feature=tables[0], split_bin=tables[1],
                       leaf_value=tables[2], bin_edges=bin_edges,

@@ -216,3 +216,33 @@ def test_row_sharded_fit_matches_single_device():
     diff = np.mean(shard.split_feature != plain.split_feature)
     assert diff < 0.05, f"{diff:.1%} of split nodes differ"
     np.testing.assert_allclose(pred_shard, pred_plain, rtol=1e-3, atol=1e-4)
+
+
+def test_fused_eval_scan_matches_host_loop():
+    """The fused on-device train+eval scan (no early stopping: one dispatch
+    for the whole history) must reproduce the host per-round loop's eval
+    history and forest — the loop is the reference-semantics oracle (xgboost
+    per-round eval reports, reference xgboost/estimator.py:54-81)."""
+    rng = np.random.RandomState(3)
+    X = rng.rand(2000, 5).astype(np.float32)
+    y = (X[:, 0] - 2 * X[:, 1] + 0.1 * rng.randn(2000)).astype(np.float32)
+    eX = rng.rand(400, 5).astype(np.float32)
+    ey = (eX[:, 0] - 2 * eX[:, 1] + 0.1 * rng.randn(400)).astype(np.float32)
+
+    kw = dict(num_trees=8, max_depth=4, num_bins=32, learning_rate=0.3,
+              evals=(eX, ey))
+    fused_model, fused_pred, fused_hist = fit_gbdt(X, y, **kw)
+    # early_stopping_rounds > num_trees never fires: the host loop runs all
+    # rounds and its history is the oracle trajectory
+    host_model, host_pred, host_hist = fit_gbdt(
+        X, y, early_stopping_rounds=kw["num_trees"] + 1, **kw)
+
+    np.testing.assert_allclose(fused_hist["eval_rmse"],
+                               host_hist["eval_rmse"][:8], rtol=1e-5)
+    np.testing.assert_array_equal(fused_model.split_feature,
+                                  host_model.split_feature)
+    np.testing.assert_array_equal(fused_model.split_bin,
+                                  host_model.split_bin)
+    np.testing.assert_allclose(fused_model.leaf_value,
+                               host_model.leaf_value, rtol=1e-5)
+    np.testing.assert_allclose(fused_pred, host_pred, rtol=1e-4, atol=1e-5)
