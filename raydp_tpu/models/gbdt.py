@@ -425,10 +425,7 @@ def fit_gbdt(
                                           (len(ey), len(base_score))).copy()
         else:
             eval_margin = np.full(len(ey), base_score, np.float32)
-        parts: List[Tuple[np.ndarray, ...]] = []
         metric_name = eval_metric(eval_margin, ey, objective)[0]
-        history: List[float] = []
-        best, best_round = np.inf, -1
         if early_stopping_rounds is None:
             # no host decisions between rounds: fuse training AND the
             # per-round eval into one device scan — one dispatch total
@@ -442,6 +439,9 @@ def fit_gbdt(
         else:
             # early stopping: the keep/stop decision is host semantics —
             # round-at-a-time with host metric checks
+            parts: List[Tuple[np.ndarray, ...]] = []
+            history: List[float] = []
+            best, best_round = np.inf, -1
             for rnd in range(num_trees):
                 trees, pred = _boost_chunk(Xb_j, y_j, w_j, pred, chunk=1,
                                            **kwargs)
