@@ -709,10 +709,25 @@ def main():
         t0 = time.perf_counter()
         result = _spawn_config(name, cap, platform)
         result["config_wall_s"] = round(time.perf_counter() - t0, 1)
+        # the platform can change mid-matrix (wedge fallback below): label
+        # each entry with what it actually ran on
+        result.setdefault("platform", platform)
         if name == "nyctaxi":
             primary = result
         extra[name] = result
         print(f"# {name}: {result}", file=sys.stderr)
+        if "timeout_s" in result and platform == "default":
+            # the tunnel can wedge MID-matrix (observed r04: configs after
+            # the wedge hang at first device touch and burn their full caps
+            # one after another). Re-probe with a short deadline; if the
+            # chip no longer computes, run the REST of the matrix on the
+            # labeled CPU fallback instead of feeding it to a dead tunnel.
+            if _probe_devices(timeout_s=min(
+                    90.0, max(30.0, deadline - time.perf_counter() - 60))) \
+                    is None:
+                platform = "cpu(tpu-wedged-midrun-fallback)"
+                print("# TPU stopped computing mid-matrix; remaining "
+                      "configs fall back to CPU", file=sys.stderr)
 
     out = {
         "metric": "nyctaxi_e2e_train_samples_per_sec_per_chip",
