@@ -694,6 +694,7 @@ def main():
 
     extra = {}
     primary = None
+    platform0 = platform  # the startup decision: what the HEADLINE ran on
     for name in selected:
         remaining = deadline - time.perf_counter()
         if remaining < MIN_CONFIG_S:
@@ -716,15 +717,21 @@ def main():
             primary = result
         extra[name] = result
         print(f"# {name}: {result}", file=sys.stderr)
-        if "timeout_s" in result and platform == "default":
+        remaining = deadline - time.perf_counter()
+        is_last = name == selected[-1]
+        if ("timeout_s" in result and platform == "default"
+                and not is_last and remaining > MIN_CONFIG_S):
             # the tunnel can wedge MID-matrix (observed r04: configs after
             # the wedge hang at first device touch and burn their full caps
             # one after another). Re-probe with a short deadline; if the
-            # chip no longer computes, run the REST of the matrix on the
-            # labeled CPU fallback instead of feeding it to a dead tunnel.
-            if _probe_devices(timeout_s=min(
-                    90.0, max(30.0, deadline - time.perf_counter() - 60))) \
-                    is None:
+            # chip no longer computes — a hung probe OR a dead tunnel whose
+            # plugin now falls back to host CPU — run the REST of the matrix
+            # on the labeled CPU fallback (scaled-down shapes) instead of
+            # feeding accelerator-sized configs to a dead tunnel. Skipped
+            # after the last config (nothing left to save) and when the
+            # probe itself would blow the budget.
+            probed = _probe_devices(timeout_s=min(90.0, remaining - 30.0))
+            if probed is None or probed == "cpu":
                 platform = "cpu(tpu-wedged-midrun-fallback)"
                 print("# TPU stopped computing mid-matrix; remaining "
                       "configs fall back to CPU", file=sys.stderr)
@@ -732,12 +739,17 @@ def main():
     out = {
         "metric": "nyctaxi_e2e_train_samples_per_sec_per_chip",
         "unit": "samples/s/chip",
-        "platform": platform,
+        # the STARTUP platform — what the headline config ran on (a mid-run
+        # wedge fallback must not relabel an already-measured TPU number);
+        # per-entry "platform" fields carry any mid-run switch
+        "platform": platform0,
         "total_wall_s": round(time.perf_counter() - t_start, 1),
         "budget_s": BUDGET_S,
         "baseline_note": "self-measured reference workload, torch CPU "
                          f"batch 8192 ({REF_NYCTAXI_B8192:.0f} samples/s; "
                          f"batch-64-as-shipped: {REF_NYCTAXI_B64:.0f})",
+        **({"platform_midrun_fallback": platform} if platform != platform0
+           else {}),
         "extra": extra,
     }
     if primary is None:
