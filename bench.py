@@ -739,10 +739,12 @@ def main():
     out = {
         "metric": "nyctaxi_e2e_train_samples_per_sec_per_chip",
         "unit": "samples/s/chip",
-        # the STARTUP platform — what the headline config ran on (a mid-run
-        # wedge fallback must not relabel an already-measured TPU number);
-        # per-entry "platform" fields carry any mid-run switch
-        "platform": platform0,
+        # what the HEADLINE config actually ran on (ordering-proof: taken
+        # from its own entry, so a mid-run wedge fallback neither relabels
+        # an already-measured TPU number nor hides that the headline itself
+        # ran on the CPU fallback); per-entry "platform" fields carry the
+        # rest of the matrix
+        "platform": (primary or {}).get("platform", platform0),
         "total_wall_s": round(time.perf_counter() - t_start, 1),
         "budget_s": BUDGET_S,
         "baseline_note": "self-measured reference workload, torch CPU "

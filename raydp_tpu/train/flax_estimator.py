@@ -122,6 +122,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         drop_last: bool = True,
         callbacks: Optional[Sequence[Callable[[Dict], None]]] = None,
         steps_per_dispatch: int = 1,
+        checkpoint_interval: int = 1,
     ):
         if model is None and model_creator is None:
             raise ValueError("pass model or model_creator")
@@ -153,6 +154,12 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         #: batch (same update sequence); the win is k× fewer host→device
         #: round trips, which dominate on a remote-tunnel TPU (~64 ms each).
         self.steps_per_dispatch = max(1, int(steps_per_dispatch))
+        #: checkpoint every N-th epoch (the final epoch always saves). The
+        #: reference checkpoints per epoch (default 1 keeps that); with the
+        #: device-resident path an epoch can be cheaper than its checkpoint,
+        #: so long runs may want a sparser cadence — a retry/resume then
+        #: replays at most N-1 epochs from the last save.
+        self.checkpoint_interval = max(1, int(checkpoint_interval))
         self._result: Optional[TrainingResult] = None
 
     # ------------------------------------------------------------------ build
@@ -467,8 +474,10 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 logger.info("epoch %d: %s", epoch,
                             {k: (round(v, 5) if isinstance(v, float) else v)
                              for k, v in report.items()})
-                ckpt.save(ckpt_dir, state, step=epoch,
-                          extra={"history": history})
+                if ((epoch + 1) % self.checkpoint_interval == 0
+                        or epoch == self.num_epochs - 1):
+                    ckpt.save(ckpt_dir, state, step=epoch,
+                              extra={"history": history})
                 epoch += 1
             except (KeyboardInterrupt, SystemExit):
                 raise
@@ -485,6 +494,19 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                     extra = ckpt.restore_extra(ckpt_dir)
                     if extra and "history" in extra:
                         history = list(extra["history"])
+                else:
+                    # no checkpoint exists yet (a failure before the first
+                    # interval save): the failed state's buffers may already
+                    # be donated away — rebuild from scratch like a fresh
+                    # fit (the keras twin's no-checkpoint branch)
+                    variables = model.init(rng, inputs0, **init_kwargs)
+                    state = self._place_state(
+                        _State.create(apply_fn=model.apply,
+                                      params=variables["params"], tx=tx,
+                                      batch_stats=variables.get("batch_stats")),
+                        state_sharding)
+                    epoch = 0
+                    history = []
 
         return state, history
 

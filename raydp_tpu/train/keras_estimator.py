@@ -80,6 +80,7 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
         drop_last: bool = True,
         fit_kwargs: Optional[Dict] = None,
         steps_per_dispatch: int = 1,
+        checkpoint_interval: int = 1,
     ):
         keras = _import_keras()
         if model is None and model_builder is None:
@@ -109,6 +110,9 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
         #: batch) — k× fewer host→device round trips, numerically identical
         #: (see FlaxEstimator.steps_per_dispatch)
         self.steps_per_dispatch = max(1, int(steps_per_dispatch))
+        #: checkpoint every N-th epoch, final epoch always (see the flax
+        #: twin; model.save of a keras archive can outweigh a resident epoch)
+        self.checkpoint_interval = max(1, int(checkpoint_interval))
         self._trained_model = None
         self._result: Optional[TrainingResult] = None
 
@@ -543,7 +547,9 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                 logger.info("keras epoch %d: %s", epoch,
                             {k: (round(v, 5) if isinstance(v, float) else v)
                              for k, v in report.items()})
-                if chief:
+                save_now = ((epoch + 1) % self.checkpoint_interval == 0
+                            or epoch == self.num_epochs - 1)
+                if chief and save_now:
                     # chief-only checkpoint (parity: tf/estimator.py:202-210)
                     # + optimizer sidecar so a resume keeps Adam slots.
                     # Every file lands via tmp+rename and the meta sidecar is
@@ -561,7 +567,8 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                     with open(tmp_meta, "w") as f:
                         _json.dump({"epoch": epoch, "history": history}, f)
                     os.replace(tmp_meta, saved_meta)
-                saved_this_run = True
+                if save_now:
+                    saved_this_run = True
                 epoch += 1
             except (KeyboardInterrupt, SystemExit):
                 raise
@@ -626,12 +633,23 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
 
             ckpt_dir = self.checkpoint_dir or tempfile.mkdtemp(
                 prefix="rdt-keras-ckpt-")
+            os.makedirs(ckpt_dir, exist_ok=True)
             callbacks = []
             if jax.process_index() == 0:
-                # chief-only checkpoint (parity: tf/estimator.py:202-210)
-                callbacks.append(keras.callbacks.ModelCheckpoint(
-                    os.path.join(ckpt_dir, "model.keras"),
-                    save_best_only=False))
+                # chief-only checkpoint (parity: tf/estimator.py:202-210);
+                # the checkpoint_interval knob applies here too (keras's
+                # ModelCheckpoint has no epoch-interval arg)
+                interval = self.checkpoint_interval
+                save_path = os.path.join(ckpt_dir, "model.keras")
+                num_epochs = self.num_epochs
+
+                class _IntervalCheckpoint(keras.callbacks.Callback):
+                    def on_epoch_end(self, epoch, logs=None):
+                        if ((epoch + 1) % interval == 0
+                                or epoch == num_epochs - 1):
+                            self.model.save(save_path)
+
+                callbacks.append(_IntervalCheckpoint())
 
             # per-epoch wall times (keras's History has none), so throughput
             # can be reported steady-state like the FlaxEstimator's

@@ -167,3 +167,19 @@ def test_keras_device_cache_parity(session, monkeypatch):
     assert any(r["feed_time_s"] > 0.0 for r in streamed.history)
     for a, b in zip(resident.history, streamed.history):
         np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5, atol=1e-6)
+
+
+def test_fit_kwargs_path_interval_checkpoint(session, tmp_path):
+    """Custom fit_kwargs route through stock model.fit; the
+    checkpoint_interval knob must hold there too (reference parity path,
+    tf/estimator.py:171-210)."""
+    import os
+
+    df = _make_frame(session, n=256)
+    ck = tmp_path / "ck"
+    est = _estimator(num_epochs=3, fit_kwargs={"class_weight": None},
+                     checkpoint_dir=str(ck), checkpoint_interval=5)
+    result = est.fit_on_frame(df)
+    assert len(result.history) == 3
+    # interval 5 > 3 epochs: only the final-epoch save lands
+    assert os.path.exists(ck / "model.keras")
