@@ -62,3 +62,10 @@ class FrameEstimatorInterface(ABC):
                 eval_ds.transfer_to_master()
             raydp_tpu.stop(cleanup_data=False)
         return train_ds, eval_ds
+
+
+def save_epoch_now(epoch: int, interval: int, num_epochs: int) -> bool:
+    """The checkpoint cadence every estimator loop shares: every
+    ``interval``-th epoch, and always the final one (so resume/get_model
+    semantics hold at any interval)."""
+    return (epoch + 1) % interval == 0 or epoch == num_epochs - 1

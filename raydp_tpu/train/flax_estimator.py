@@ -30,7 +30,11 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from raydp_tpu.log import get_logger
-from raydp_tpu.train.estimator import EstimatorInterface, FrameEstimatorInterface
+from raydp_tpu.train.estimator import (
+    EstimatorInterface,
+    FrameEstimatorInterface,
+    save_epoch_now,
+)
 from raydp_tpu.train.metrics import Metric, build_metrics
 
 logger = get_logger("train.flax_estimator")
@@ -379,6 +383,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         history: List[Dict[str, float]] = []
         epoch = 0
         retries = 0
+        saved_this_run = False
         if resume:
             restored = ckpt.restore_placed(ckpt_dir, state, state_sharding)
             if restored is not None:
@@ -474,10 +479,11 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 logger.info("epoch %d: %s", epoch,
                             {k: (round(v, 5) if isinstance(v, float) else v)
                              for k, v in report.items()})
-                if ((epoch + 1) % self.checkpoint_interval == 0
-                        or epoch == self.num_epochs - 1):
+                if save_epoch_now(epoch, self.checkpoint_interval,
+                                  self.num_epochs):
                     ckpt.save(ckpt_dir, state, step=epoch,
                               extra={"history": history})
+                    saved_this_run = True
                 epoch += 1
             except (KeyboardInterrupt, SystemExit):
                 raise
@@ -487,7 +493,13 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                     raise
                 logger.warning("epoch %d failed (%s); restoring from checkpoint "
                                "(retry %d/%d)", epoch, e, retries, max_retries)
-                restored = ckpt.restore_placed(ckpt_dir, state, state_sharding)
+                # adopt a checkpoint only if THIS run (or an explicit
+                # resume) wrote/claimed it: a stale dir from an earlier run
+                # must not short-circuit a fresh fit to its old model (same
+                # guard the keras stateless loop carries)
+                restored = ckpt.restore_placed(
+                    ckpt_dir, state, state_sharding) \
+                    if (resume or saved_this_run) else None
                 if restored is not None:
                     state, done_epoch = restored
                     epoch = done_epoch + 1
@@ -495,10 +507,10 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                     if extra and "history" in extra:
                         history = list(extra["history"])
                 else:
-                    # no checkpoint exists yet (a failure before the first
-                    # interval save): the failed state's buffers may already
-                    # be donated away — rebuild from scratch like a fresh
-                    # fit (the keras twin's no-checkpoint branch)
+                    # no checkpoint from this run (a failure before the
+                    # first interval save): the failed state's buffers may
+                    # already be donated away — rebuild from scratch like a
+                    # fresh fit (the keras twin's no-checkpoint branch)
                     variables = model.init(rng, inputs0, **init_kwargs)
                     state = self._place_state(
                         _State.create(apply_fn=model.apply,

@@ -38,7 +38,11 @@ from typing import Callable, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from raydp_tpu.log import get_logger
-from raydp_tpu.train.estimator import EstimatorInterface, FrameEstimatorInterface
+from raydp_tpu.train.estimator import (
+    EstimatorInterface,
+    FrameEstimatorInterface,
+    save_epoch_now,
+)
 from raydp_tpu.train.flax_estimator import TrainingResult
 
 logger = get_logger("train.keras_estimator")
@@ -547,8 +551,8 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                 logger.info("keras epoch %d: %s", epoch,
                             {k: (round(v, 5) if isinstance(v, float) else v)
                              for k, v in report.items()})
-                save_now = ((epoch + 1) % self.checkpoint_interval == 0
-                            or epoch == self.num_epochs - 1)
+                save_now = save_epoch_now(epoch, self.checkpoint_interval,
+                                          self.num_epochs)
                 if chief and save_now:
                     # chief-only checkpoint (parity: tf/estimator.py:202-210)
                     # + optimizer sidecar so a resume keeps Adam slots.
@@ -634,6 +638,8 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
             ckpt_dir = self.checkpoint_dir or tempfile.mkdtemp(
                 prefix="rdt-keras-ckpt-")
             os.makedirs(ckpt_dir, exist_ok=True)
+            saved_marker = {"saved": False}  # only THIS run's checkpoint may
+            # be adopted by a retry — never a stale file from a reused dir
             callbacks = []
             if jax.process_index() == 0:
                 # chief-only checkpoint (parity: tf/estimator.py:202-210);
@@ -645,9 +651,9 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
 
                 class _IntervalCheckpoint(keras.callbacks.Callback):
                     def on_epoch_end(self, epoch, logs=None):
-                        if ((epoch + 1) % interval == 0
-                                or epoch == num_epochs - 1):
+                        if save_epoch_now(epoch, interval, num_epochs):
                             self.model.save(save_path)
+                            saved_marker["saved"] = True
 
                 callbacks.append(_IntervalCheckpoint())
 
@@ -701,7 +707,8 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                     if attempt > max_retries:
                         raise
                     saved = os.path.join(ckpt_dir, "model.keras")
-                    if jax.process_count() == 1 and os.path.exists(saved):
+                    if (jax.process_count() == 1 and saved_marker["saved"]
+                            and os.path.exists(saved)):
                         logger.warning("keras fit failed (%s); retry %d/%d "
                                        "from checkpoint", e, attempt,
                                        max_retries)

@@ -1,0 +1,118 @@
+"""Bench-harness control flow: the budget/cap/wedge machinery that decides
+whether a round records numbers at all (r03 recorded nothing; r04's tunnel
+wedged mid-matrix). Probe and config children are faked so the logic is
+testable without hardware."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+
+@pytest.fixture
+def bench(monkeypatch, tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # keep the compile-cache setup away from the repo during tests
+    monkeypatch.setenv("RDT_JAX_CACHE_DIR", str(tmp_path / "jc"))
+    return mod
+
+
+def _run_main(bench, capsys):
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    return json.loads(out)
+
+
+def test_mid_matrix_wedge_falls_back_to_cpu(bench, monkeypatch, capsys):
+    """A config timeout on the TPU platform + a failed re-probe must switch
+    the REST of the matrix to the labeled CPU fallback (r04: a mid-matrix
+    wedge made every later config burn its full cap)."""
+    monkeypatch.setenv("BENCH_CONFIGS", "nyctaxi,gbdt,keras")
+    calls = []
+
+    def fake_spawn(name, cap_s, platform):
+        calls.append((name, platform))
+        if name == "nyctaxi":
+            return {"samples_per_s_per_chip": 1000.0}
+        if name == "gbdt":
+            return {"timeout_s": cap_s, "error": "wall cap"}
+        return {"samples_per_s_per_chip": 5.0}
+
+    monkeypatch.setattr(bench, "_spawn_config", fake_spawn)
+    # startup probe says TPU; the mid-run re-probe (after gbdt's timeout)
+    # hangs — exactly the wedge signature
+    probes = iter(["tpu", None])
+    monkeypatch.setattr(bench, "_probe_devices",
+                        lambda timeout_s=None: next(probes))
+
+    out = _run_main(bench, capsys)
+    assert calls == [("nyctaxi", "default"), ("gbdt", "default"),
+                     ("keras", "cpu(tpu-wedged-midrun-fallback)")]
+    # the headline ran on TPU and must stay labeled that way
+    assert out["platform"] == "default"
+    assert out["platform_midrun_fallback"] == "cpu(tpu-wedged-midrun-fallback)"
+    assert out["value"] == 1000.0
+    assert out["extra"]["keras"]["platform"] == \
+        "cpu(tpu-wedged-midrun-fallback)"
+
+
+def test_wedged_headline_is_labeled_cpu(bench, monkeypatch, capsys):
+    """Ordering-proof labeling: when the wedge fires BEFORE the headline
+    config, the top-level platform must report the fallback the headline
+    actually ran on — never the startup decision."""
+    monkeypatch.setenv("BENCH_CONFIGS", "gbdt,nyctaxi")
+
+    def fake_spawn(name, cap_s, platform):
+        if name == "gbdt":
+            return {"timeout_s": cap_s, "error": "wall cap"}
+        return {"samples_per_s_per_chip": 42.0}
+
+    monkeypatch.setattr(bench, "_spawn_config", fake_spawn)
+    probes = iter(["tpu", "cpu"])  # dead tunnel: plugin falls back to host
+    monkeypatch.setattr(bench, "_probe_devices",
+                        lambda timeout_s=None: next(probes))
+
+    out = _run_main(bench, capsys)
+    assert out["platform"] == "cpu(tpu-wedged-midrun-fallback)"
+    assert out["value"] == 42.0
+
+
+def test_budget_skips_are_explicit(bench, monkeypatch, capsys):
+    """Configs that do not fit the budget are recorded as skipped markers —
+    never silently absent (r03's lesson: the driver must always get JSON)."""
+    monkeypatch.setenv("BENCH_CONFIGS", "nyctaxi,gbdt")
+    monkeypatch.setattr(bench, "BUDGET_S", 0.0)  # read at import time
+    monkeypatch.setattr(bench, "_spawn_config",
+                        lambda *a: pytest.fail("nothing should spawn"))
+    monkeypatch.setattr(bench, "_probe_devices", lambda timeout_s=None: "tpu")
+
+    out = _run_main(bench, capsys)
+    assert out["extra"]["nyctaxi"]["skipped"] == "budget"
+    assert out["extra"]["gbdt"]["skipped"] == "budget"
+    assert out["value"] == 0.0 and out["vs_baseline"] == 0.0
+
+
+def test_last_config_timeout_skips_reprobe(bench, monkeypatch, capsys):
+    """No re-probe after the last config: nothing is left to save, and the
+    probe's wall would only overshoot the budget."""
+    monkeypatch.setenv("BENCH_CONFIGS", "nyctaxi")
+    monkeypatch.setattr(
+        bench, "_spawn_config",
+        lambda name, cap_s, platform: {"timeout_s": cap_s, "error": "cap"})
+    probe_calls = {"n": 0}
+
+    def probe(timeout_s=None):
+        probe_calls["n"] += 1
+        return "tpu"
+
+    monkeypatch.setattr(bench, "_probe_devices", probe)
+    out = _run_main(bench, capsys)
+    assert probe_calls["n"] == 1  # the startup probe only
+    assert out["value"] == 0.0

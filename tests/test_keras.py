@@ -169,11 +169,23 @@ def test_keras_device_cache_parity(session, monkeypatch):
         np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5, atol=1e-6)
 
 
-def test_fit_kwargs_path_interval_checkpoint(session, tmp_path):
+def test_fit_kwargs_path_interval_checkpoint(session, tmp_path, monkeypatch):
     """Custom fit_kwargs route through stock model.fit; the
     checkpoint_interval knob must hold there too (reference parity path,
-    tf/estimator.py:171-210)."""
+    tf/estimator.py:171-210). A save spy pins the cadence — existence of the
+    final archive alone cannot distinguish interval from save-every-epoch."""
     import os
+
+    import keras
+
+    saves = []
+    real_save = keras.Model.save
+
+    def spy(self, path, *a, **kw):
+        saves.append(os.path.basename(str(path)))
+        return real_save(self, path, *a, **kw)
+
+    monkeypatch.setattr(keras.Model, "save", spy)
 
     df = _make_frame(session, n=256)
     ck = tmp_path / "ck"
@@ -181,5 +193,6 @@ def test_fit_kwargs_path_interval_checkpoint(session, tmp_path):
                      checkpoint_dir=str(ck), checkpoint_interval=5)
     result = est.fit_on_frame(df)
     assert len(result.history) == 3
-    # interval 5 > 3 epochs: only the final-epoch save lands
+    # interval 5 > 3 epochs: exactly ONE save — the forced final-epoch one
+    assert saves == ["model.keras"]
     assert os.path.exists(ck / "model.keras")

@@ -368,3 +368,41 @@ def test_retry_before_first_interval_save_rebuilds(session):
     result = est.fit_on_frame(df, max_retries=1)
     assert len(result.history) == 2
     assert np.isfinite(result.history[-1]["train_loss"])
+
+
+def test_retry_ignores_stale_checkpoint_dir(session, tmp_path):
+    """A fresh fit reusing a checkpoint_dir from an EARLIER run must not
+    adopt that run's checkpoint on retry — only checkpoints this run wrote
+    (or an explicit resume) may restore; otherwise the retry silently
+    returns the old model and history."""
+    import optax
+
+    df = _linear_df(session, n=512)
+    ck = str(tmp_path / "ck")
+
+    def make(**kw):
+        return FlaxEstimator(
+            model=MLP(features=(8,), use_batch_norm=False),
+            optimizer=optax.adam(1e-2),
+            loss="mse",
+            feature_columns=["x1", "x2"],
+            label_column="y",
+            batch_size=64,
+            checkpoint_dir=ck,
+            **kw,
+        )
+
+    make(num_epochs=4).fit_on_frame(df)  # run A leaves step_3 behind
+
+    calls = {"n": 0}
+
+    def boom(report):
+        if calls["n"] == 0:
+            calls["n"] += 1
+            raise RuntimeError("transient")
+
+    result = make(num_epochs=2, checkpoint_interval=10,
+                  callbacks=[boom]).fit_on_frame(df, max_retries=1)
+    # adopted-stale would return run A's 4-epoch history; fresh rebuild
+    # trains exactly this run's 2 epochs
+    assert len(result.history) == 2
