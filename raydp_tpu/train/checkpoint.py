@@ -69,7 +69,8 @@ def _step_dirs(ckpt_dir: str, complete_only: bool = True):
     return sorted(out)
 
 
-def _latest_agreed(ckpt_dir: str) -> Optional[Tuple[int, str]]:
+def _latest_agreed(ckpt_dir: str, max_step: Optional[int] = None
+                   ) -> Optional[Tuple[int, str]]:
     """The ``(step, path)`` every rank will restore.
 
     Single process: the locally-latest complete step. Multi-process gang:
@@ -77,8 +78,14 @@ def _latest_agreed(ckpt_dir: str) -> Optional[Tuple[int, str]]:
     visibility on networked storage), and ranks resuming different epochs
     deadlock the first collective — so every rank takes the CHIEF's choice
     (broadcast), and a rank that cannot see that step fails fast with a
-    shared-storage message instead of silently training from elsewhere."""
+    shared-storage message instead of silently training from elsewhere.
+
+    ``max_step`` bounds the choice: a fresh fit's retry passes the highest
+    step it wrote itself, so stale higher-step dirs left in a reused
+    checkpoint_dir by an earlier run are never adopted."""
     steps = _step_dirs(ckpt_dir)
+    if max_step is not None:
+        steps = [s for s in steps if s[0] <= max_step]
     import jax
     if jax.process_count() <= 1:
         return steps[-1] if steps else None
@@ -236,9 +243,20 @@ def _save_sharded(ckpt_dir: str, state: Any, step: int,
         if extra is not None:
             _write_extra(path, ckpt_dir, step, extra)
         open(os.path.join(path, "COMPLETE"), "w").close()
-        for _, old in _step_dirs(ckpt_dir, complete_only=False)[:-_KEEP]:
-            shutil.rmtree(old, ignore_errors=True)
+        _prune(ckpt_dir, step)
     return path
+
+
+def _prune(ckpt_dir: str, written_step: int) -> None:
+    """Retention: keep the newest ``_KEEP`` steps AT OR BELOW the one just
+    written. Bounding at ``written_step`` means stale higher-step dirs in a
+    reused directory are left alone (they are foreign data, and pruning
+    lower steps in their favor would delete the checkpoint written
+    milliseconds earlier while keeping another run's)."""
+    steps = [s for s in _step_dirs(ckpt_dir, complete_only=False)
+             if s[0] <= written_step]
+    for _, old in steps[:-_KEEP]:
+        shutil.rmtree(old, ignore_errors=True)
 
 
 def save(ckpt_dir: str, state: Any, step: int,
@@ -260,10 +278,7 @@ def save(ckpt_dir: str, state: Any, step: int,
         ckptr.save(path, jax.device_get(state))
     if extra is not None:
         _write_extra(path, ckpt_dir, step, extra)
-    # retention: keep the newest _KEEP
-    steps = _step_dirs(ckpt_dir, complete_only=False)
-    for _, old in steps[:-_KEEP]:
-        shutil.rmtree(old, ignore_errors=True)
+    _prune(ckpt_dir, step)
     return path
 
 
@@ -427,13 +442,16 @@ def place_tree(tree: Any, shardings: Any) -> Any:
     return jax.tree.map(_put, tree, shardings, is_leaf=lambda x: x is None)
 
 
-def restore_placed(ckpt_dir: str, template: Any,
-                   shardings: Any) -> Optional[Tuple[Any, int]]:
+def restore_placed(ckpt_dir: str, template: Any, shardings: Any,
+                   max_step: Optional[int] = None
+                   ) -> Optional[Tuple[Any, int]]:
     """Restore the latest checkpoint and place it under ``shardings`` —
     correct in both single-process and gang topologies, for both formats.
     Sharded-format checkpoints restore shard-locally (each process reads only
-    what its devices address). Returns ``(placed_state, step)`` or None."""
-    latest = _latest_agreed(ckpt_dir)
+    what its devices address). Returns ``(placed_state, step)`` or None.
+    ``max_step`` restricts to steps the caller knows are its own (see
+    :func:`_latest_agreed`)."""
+    latest = _latest_agreed(ckpt_dir, max_step=max_step)
     if latest is None:
         return None
     step, path = latest
@@ -444,13 +462,14 @@ def restore_placed(ckpt_dir: str, template: Any,
     return place_tree(host_state, shardings), step
 
 
-def restore_extra(ckpt_dir: str) -> Optional[dict]:
+def restore_extra(ckpt_dir: str, max_step: Optional[int] = None
+                  ) -> Optional[dict]:
     """The JSON sidecar of the latest checkpoint, or None. Gang-agreed like
     the state restore: divergent epoch bookkeeping would desynchronize the
     ranks' collective counts."""
     import json
 
-    latest = _latest_agreed(ckpt_dir)
+    latest = _latest_agreed(ckpt_dir, max_step=max_step)
     if latest is None:
         return None
     path = os.path.join(latest[1], "extra.json")

@@ -383,7 +383,10 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         history: List[Dict[str, float]] = []
         epoch = 0
         retries = 0
-        saved_this_run = False
+        #: highest checkpoint step THIS run wrote — a retry may only restore
+        #: up to it; a reused dir's stale steps (possibly HIGHER-numbered,
+        #: which latest-step selection would otherwise prefer) are foreign
+        last_written_step: Optional[int] = None
         if resume:
             restored = ckpt.restore_placed(ckpt_dir, state, state_sharding)
             if restored is not None:
@@ -483,7 +486,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                                   self.num_epochs):
                     ckpt.save(ckpt_dir, state, step=epoch,
                               extra={"history": history})
-                    saved_this_run = True
+                    last_written_step = epoch
                 epoch += 1
             except (KeyboardInterrupt, SystemExit):
                 raise
@@ -493,17 +496,26 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                     raise
                 logger.warning("epoch %d failed (%s); restoring from checkpoint "
                                "(retry %d/%d)", epoch, e, retries, max_retries)
-                # adopt a checkpoint only if THIS run (or an explicit
-                # resume) wrote/claimed it: a stale dir from an earlier run
-                # must not short-circuit a fresh fit to its old model (same
-                # guard the keras stateless loop carries)
-                restored = ckpt.restore_placed(
-                    ckpt_dir, state, state_sharding) \
-                    if (resume or saved_this_run) else None
+                # adopt a checkpoint only if an explicit resume claimed the
+                # dir, or THIS run wrote it — and then only up to the step
+                # this run wrote (a reused dir's stale higher-numbered steps
+                # would otherwise win latest-step selection and silently
+                # return an earlier run's model)
+                if resume:
+                    restored = ckpt.restore_placed(ckpt_dir, state,
+                                                   state_sharding)
+                elif last_written_step is not None:
+                    restored = ckpt.restore_placed(
+                        ckpt_dir, state, state_sharding,
+                        max_step=last_written_step)
+                else:
+                    restored = None
                 if restored is not None:
                     state, done_epoch = restored
                     epoch = done_epoch + 1
-                    extra = ckpt.restore_extra(ckpt_dir)
+                    extra = ckpt.restore_extra(
+                        ckpt_dir,
+                        max_step=None if resume else last_written_step)
                     if extra and "history" in extra:
                         history = list(extra["history"])
                 else:

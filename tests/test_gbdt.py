@@ -246,3 +246,28 @@ def test_fused_eval_scan_matches_host_loop():
     np.testing.assert_allclose(fused_model.leaf_value,
                                host_model.leaf_value, rtol=1e-5)
     np.testing.assert_allclose(fused_pred, host_pred, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_eval_scan_matches_host_loop_multiclass():
+    """Multiclass twin of the fused-eval parity test: the vmapped K-tree
+    round and the [K, nodes] eval routing must also match the host loop."""
+    rng = np.random.RandomState(5)
+    X = rng.rand(1500, 4).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32) \
+        + (X[:, 2] > 0.66).astype(np.float32)  # 3 classes
+    eX = rng.rand(300, 4).astype(np.float32)
+    ey = (eX[:, 0] + eX[:, 1] > 1.0).astype(np.float32) \
+        + (eX[:, 2] > 0.66).astype(np.float32)
+
+    kw = dict(num_trees=6, max_depth=3, num_bins=32, learning_rate=0.4,
+              objective="multi:softmax", num_class=3, evals=(eX, ey))
+    fused_model, _, fused_hist = fit_gbdt(X, y, **kw)
+    host_model, _, host_hist = fit_gbdt(
+        X, y, early_stopping_rounds=kw["num_trees"] + 1, **kw)
+
+    np.testing.assert_allclose(fused_hist["eval_mlogloss"],
+                               host_hist["eval_mlogloss"][:6], rtol=1e-5)
+    np.testing.assert_array_equal(fused_model.split_feature,
+                                  host_model.split_feature)
+    np.testing.assert_allclose(fused_model.leaf_value,
+                               host_model.leaf_value, rtol=1e-5)

@@ -196,3 +196,28 @@ def test_fit_kwargs_path_interval_checkpoint(session, tmp_path, monkeypatch):
     # interval 5 > 3 epochs: exactly ONE save — the forced final-epoch one
     assert saves == ["model.keras"]
     assert os.path.exists(ck / "model.keras")
+
+
+def test_keras_batchnorm_resident(session):
+    """BatchNorm (non-trainable running stats) threads through the resident
+    epoch scan's carry — the bench's NYCTaxi-shaped keras model depends on
+    it."""
+    import keras
+
+    def build():
+        return keras.Sequential([
+            keras.layers.Input(shape=(2,)),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.BatchNormalization(),
+            keras.layers.Dense(1),
+        ])
+
+    df = _make_frame(session, n=448)
+    est = _estimator(model=None, model_builder=build, num_epochs=3)
+    result = est.fit_on_frame(df)
+    assert all(r["feed_time_s"] == 0.0 for r in result.history)
+    assert result.history[-1]["loss"] < result.history[0]["loss"]
+    # the running stats must have moved off their init (mean 0 / var 1)
+    bn = [v for v in est.get_model().non_trainable_variables]
+    moving_mean = np.asarray(bn[0])
+    assert np.abs(moving_mean).max() > 1e-3

@@ -406,3 +406,20 @@ def test_retry_ignores_stale_checkpoint_dir(session, tmp_path):
     # adopted-stale would return run A's 4-epoch history; fresh rebuild
     # trains exactly this run's 2 epochs
     assert len(result.history) == 2
+
+    # the harder mixed case: run C saves step_0, then fails — the retry
+    # must restore run C's OWN step_0 (and retention must not have pruned
+    # it in favor of run A's higher-numbered stale steps, which latest-step
+    # selection would otherwise adopt)
+    calls2 = {"n": 0}
+
+    def boom_epoch1(report):
+        if report["epoch"] == 1 and calls2["n"] == 0:
+            calls2["n"] += 1
+            raise RuntimeError("transient at epoch 1")
+
+    result_c = make(num_epochs=2, checkpoint_interval=1,
+                    callbacks=[boom_epoch1]).fit_on_frame(df, max_retries=1)
+    # run C resumed from its own epoch-0 save: exactly 2 epoch reports,
+    # not run A's 4
+    assert len(result_c.history) == 2
