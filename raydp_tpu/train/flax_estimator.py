@@ -219,18 +219,33 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
             feed = DeviceFeed(train_ds, self.batch_size, columns, mesh=mesh,
                               shuffle=self.shuffle, seed=self.seed,
                               drop_remainder=self.drop_last)
-        eval_feed = None
+        eval_feed = eval_cache = None
+        eval_tail_ok = False
         if evaluate_ds is not None:
             # a ragged final batch cannot shard over a >1 data axis; drop it
             # there (static shapes also avoid one extra XLA compile)
             from raydp_tpu.parallel.mesh import data_axes
             dp_total = int(np.prod([mesh.shape[a] for a in data_axes(mesh)]))
-            eval_feed = DeviceFeed(evaluate_ds, self.batch_size, columns,
-                                   mesh=mesh, shuffle=False,
-                                   drop_remainder=dp_total > 1)
+            eval_tail_ok = dp_total == 1  # the tail-batch rule, decided HERE
+            # beside the drop_remainder rule so the two cannot disagree
+            # eval goes resident alongside the train set: the whole eval
+            # pass becomes one scan dispatch (+ one for the ragged tail)
+            # instead of one dispatch per batch, every epoch. The budget is
+            # COMBINED: train + eval residency together stay under the cap
+            if (cache is not None
+                    and DeviceEpochCache.eligible(evaluate_ds, columns,
+                                                  1, True)
+                    and cache.nbytes + DeviceEpochCache.estimate_bytes(
+                        evaluate_ds, columns) <= DeviceEpochCache.cap_bytes()):
+                eval_cache = DeviceEpochCache(evaluate_ds, columns, mesh=mesh)
+            else:
+                eval_feed = DeviceFeed(evaluate_ds, self.batch_size, columns,
+                                       mesh=mesh, shuffle=False,
+                                       drop_remainder=dp_total > 1)
 
-        state, history = self._train_loop(mesh, feed, eval_feed, ckpt_dir,
-                                          max_retries=max_retries, cache=cache)
+        state, history = self._train_loop(
+            mesh, feed, eval_feed, ckpt_dir, max_retries=max_retries,
+            cache=cache, eval_cache=eval_cache, eval_tail_ok=eval_tail_ok)
         self._result = TrainingResult(state=state, history=history,
                                       checkpoint_dir=ckpt_dir)
         return self._result
@@ -243,7 +258,8 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         return ckpt.place_tree(tree, shardings)
 
     def _train_loop(self, mesh, feed, eval_feed, ckpt_dir: str,
-                    max_retries: int = 0, resume: bool = False, cache=None):
+                    max_retries: int = 0, resume: bool = False, cache=None,
+                    eval_cache=None, eval_tail_ok: bool = False):
         import jax
         import jax.numpy as jnp
         import optax
@@ -380,6 +396,33 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 batch_sharding=b_sharding)
             jit_epoch = jax.jit(epoch_fn, donate_argnums=(0,))
 
+        jit_eval_epoch = None
+        eval_tail = None
+        eval_cache_rows = 0
+        if eval_cache is not None:
+            # the whole eval pass as ONE scan dispatch, built by the same
+            # make_epoch_fn as the train scan (one source for the
+            # slice/constraint/scan logic); the ragged tail travels as one
+            # extra jitted call where a single data shard allows it
+            # (matching the streaming feed's drop-remainder rule, decided
+            # in fit() as eval_tail_ok). The carry rides the state through
+            # unchanged — NOT donated (it lives on into the next epoch)
+            def _eval_scan_step(carry, batch):
+                state, estats, esum = carry
+                esum, estats = eval_step(state, batch, estats, esum)
+                return state, estats, esum
+
+            eval_epoch_fn, esteps = eval_cache.make_epoch_fn(
+                _eval_scan_step, self.batch_size, shuffle=False,
+                batch_sharding=b_sharding)
+            jit_eval_epoch = jax.jit(eval_epoch_fn)
+            eval_cache_rows = esteps * self.batch_size
+            tail_rows = eval_cache.num_rows - eval_cache_rows
+            if tail_rows > 0 and eval_tail_ok:
+                eval_tail = {n: a[eval_cache_rows:]
+                             for n, a in eval_cache.arrays.items()}
+                eval_cache_rows += tail_rows
+
         history: List[Dict[str, float]] = []
         epoch = 0
         retries = 0
@@ -463,13 +506,23 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                     report[f"train_{m.name}"] = m.compute(
                         jax.tree.map(np.asarray, s))
 
-                if eval_feed is not None:
+                if eval_feed is not None or eval_cache is not None:
                     estats = tuple(m.init() for m in metrics)
                     esum = np.zeros((), np.float32)
-                    ecnt = 0  # exact host-side int (shapes are static, no sync)
-                    for batch in eval_feed:
-                        ecnt += int(next(iter(batch.values())).shape[0])
-                        esum, estats = jit_eval(state, batch, estats, esum)
+                    if eval_cache is not None:
+                        ecnt = eval_cache_rows
+                        _, estats, esum = jit_eval_epoch(
+                            (state, estats, esum), eval_cache.arrays,
+                            jax.random.PRNGKey(0))  # unused: shuffle=False
+                        if eval_tail is not None:
+                            esum, estats = jit_eval(state, eval_tail,
+                                                    estats, esum)
+                    else:
+                        ecnt = 0  # exact host-side int (static shapes)
+                        for batch in eval_feed:
+                            ecnt += int(next(iter(batch.values())).shape[0])
+                            esum, estats = jit_eval(state, batch, estats,
+                                                    esum)
                     report["eval_loss"] = (float(esum) / ecnt) if ecnt \
                         else float("nan")
                     for m, s in zip(metrics, estats):

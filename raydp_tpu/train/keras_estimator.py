@@ -222,15 +222,25 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
             feed = DeviceFeed(train_ds, self.batch_size, columns, mesh=mesh,
                               shuffle=self.shuffle, seed=self.seed,
                               drop_remainder=self.drop_last)
-        eval_feed = None
+        eval_feed = eval_cache = None
         if evaluate_ds is not None:
             dp_total = int(_np.prod([mesh.shape[a] for a in data_axes(mesh)]))
-            eval_feed = DeviceFeed(evaluate_ds, self.batch_size, columns,
-                                   mesh=mesh, shuffle=False,
-                                   drop_remainder=dp_total > 1)
+            # resident eval beside resident train: one scan dispatch per
+            # eval pass, under a COMBINED train+eval budget (see flax twin)
+            if (cache is not None
+                    and DeviceEpochCache.eligible(evaluate_ds, columns,
+                                                  1, True)
+                    and cache.nbytes + DeviceEpochCache.estimate_bytes(
+                        evaluate_ds, columns) <= DeviceEpochCache.cap_bytes()):
+                eval_cache = DeviceEpochCache(evaluate_ds, columns, mesh=mesh)
+            else:
+                eval_feed = DeviceFeed(evaluate_ds, self.batch_size, columns,
+                                       mesh=mesh, shuffle=False,
+                                       drop_remainder=dp_total > 1)
         model, history = self._stateless_train_loop(
             mesh, feed, eval_feed, ckpt_dir, max_retries=max_retries,
-            cache=cache)
+            cache=cache, eval_cache=eval_cache,
+            eval_tail_ok=evaluate_ds is not None and dp_total == 1)
         self._trained_model = model
         self._result = TrainingResult(state=model, history=history,
                                       checkpoint_dir=ckpt_dir)
@@ -266,7 +276,8 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
 
     def _stateless_train_loop(self, mesh, feed, eval_feed, ckpt_dir: str,
                               max_retries: int = 0, resume: bool = False,
-                              cache=None):
+                              cache=None, eval_cache=None,
+                              eval_tail_ok: bool = False):
         """One jitted train step over stateless Keras calls; in-jit loss and
         metric accumulation; donated state buffers; chief-only per-epoch
         ``model.keras`` checkpoint with a JSON epoch/history sidecar.
@@ -457,6 +468,32 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                 batch_sharding=batch_sharding(mesh))
             jit_epoch = jax.jit(epoch_fn, donate_argnums=(0,))
 
+        jit_eval_epoch = None
+        eval_tail = None
+        eval_cache_rows = 0
+        if eval_cache is not None:
+            # whole eval pass as one scan dispatch, built by the shared
+            # make_epoch_fn; ragged tail as one jitted call where the
+            # caller-decided eval_tail_ok rule allows (the flax twin's
+            # shape). Carry rides tv/ntv through unchanged — not donated
+            from raydp_tpu.parallel.mesh import batch_sharding
+
+            def _eval_scan_step(carry, batch):
+                tv, ntv, mvars, loss_sum = carry
+                mvars, loss_sum = eval_step(tv, ntv, mvars, loss_sum, batch)
+                return tv, ntv, mvars, loss_sum
+
+            eval_epoch_fn, esteps = eval_cache.make_epoch_fn(
+                _eval_scan_step, self.batch_size, shuffle=False,
+                batch_sharding=batch_sharding(mesh))
+            jit_eval_epoch = jax.jit(eval_epoch_fn)
+            eval_cache_rows = esteps * self.batch_size
+            tail_rows = eval_cache.num_rows - eval_cache_rows
+            if tail_rows > 0 and eval_tail_ok:
+                eval_tail = {n: a[eval_cache_rows:]
+                             for n, a in eval_cache.arrays.items()}
+                eval_cache_rows += tail_rows
+
         def _host_val(a):
             """Host copy of a replicated array (the local replica shard IS
             the full value — collective-free even across processes)."""
@@ -534,13 +571,22 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                 for m, mv in zip(train_metrics, mvars):
                     report[m.name] = float(m.stateless_result(list(mv)))
 
-                if eval_feed is not None:
+                if eval_feed is not None or eval_cache is not None:
                     emv = _mvars(em_init)
                     esum = jnp.zeros((), jnp.float32)
-                    ecnt = 0
-                    for batch in eval_feed:
-                        ecnt += int(next(iter(batch.values())).shape[0])
-                        emv, esum = jit_eval(tv, ntv, emv, esum, batch)
+                    if eval_cache is not None:
+                        ecnt = eval_cache_rows
+                        _, _, emv, esum = jit_eval_epoch(
+                            (tv, ntv, emv, esum), eval_cache.arrays,
+                            jax.random.PRNGKey(0))  # unused: shuffle=False
+                        if eval_tail is not None:
+                            emv, esum = jit_eval(tv, ntv, emv, esum,
+                                                 eval_tail)
+                    else:
+                        ecnt = 0
+                        for batch in eval_feed:
+                            ecnt += int(next(iter(batch.values())).shape[0])
+                            emv, esum = jit_eval(tv, ntv, emv, esum, batch)
                     report["val_loss"] = (float(esum) / ecnt) if ecnt \
                         else float("nan")
                     for m, mv in zip(eval_metrics, emv):

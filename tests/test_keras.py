@@ -151,14 +151,16 @@ def test_keras_device_cache_parity(session, monkeypatch):
     """The device-resident epoch path must walk exactly the streaming feed's
     update sequence at shuffle=False (mirrors the FlaxEstimator resident
     parity test, on the keras stateless loop)."""
+    from raydp_tpu.data import from_frame
+
     df = _make_frame(session, n=448)
+    eval_ds = from_frame(_make_frame(session, n=200, seed=1))
     monkeypatch.setenv("RDT_DEVICE_CACHE", "1")
     monkeypatch.delenv("RDT_DEVICE_CACHE_MB", raising=False)
 
     def run():
-        from raydp_tpu.data import from_frame
         est = _estimator(num_epochs=2, shuffle=False)
-        return est.fit(from_frame(df))
+        return est.fit(from_frame(df), eval_ds)
 
     resident = run()
     assert all(r["feed_time_s"] == 0.0 for r in resident.history)
@@ -167,6 +169,12 @@ def test_keras_device_cache_parity(session, monkeypatch):
     assert any(r["feed_time_s"] > 0.0 for r in streamed.history)
     for a, b in zip(resident.history, streamed.history):
         np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-5, atol=1e-6)
+        # the resident eval scan must match the streaming eval pass
+        np.testing.assert_allclose(a["val_loss"], b["val_loss"],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(a["val_mean_absolute_error"],
+                                   b["val_mean_absolute_error"],
+                                   rtol=1e-5, atol=1e-6)
 
 
 def test_fit_kwargs_path_interval_checkpoint(session, tmp_path, monkeypatch):

@@ -238,6 +238,8 @@ def test_device_cache_parity_and_fallback(session, monkeypatch):
     monkeypatch.setenv("RDT_DEVICE_CACHE", "1")
     monkeypatch.delenv("RDT_DEVICE_CACHE_MB", raising=False)
 
+    eval_ds = from_frame(_linear_df(session, n=333))  # ragged vs batch 64
+
     def run():
         est = FlaxEstimator(
             model=MLP(features=(8,), use_batch_norm=True),
@@ -249,8 +251,9 @@ def test_device_cache_parity_and_fallback(session, monkeypatch):
             num_epochs=2,
             shuffle=False,
             seed=0,
+            metrics=["mae"],
         )
-        return est.fit(ds)
+        return est.fit(ds, eval_ds)
 
     resident = run()
     # the resident path does no host-side feeding at all
@@ -264,6 +267,12 @@ def test_device_cache_parity_and_fallback(session, monkeypatch):
         [r["steps"] for r in streamed.history]
     for a, b in zip(resident.history, streamed.history):
         np.testing.assert_allclose(a["train_loss"], b["train_loss"],
+                                   rtol=1e-5, atol=1e-6)
+        # the resident EVAL scan (+ tail rule) must match the streaming
+        # eval pass exactly too
+        np.testing.assert_allclose(a["eval_loss"], b["eval_loss"],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(a["eval_mae"], b["eval_mae"],
                                    rtol=1e-5, atol=1e-6)
 
     # a zero budget must also fall back (estimate > cap)
