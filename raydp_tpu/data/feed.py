@@ -275,6 +275,13 @@ class GangShardIterator:
         self.per_rank = int(hi) - int(lo)
         self._starts = np.cumsum([0] + list(dataset.block_sizes()))
         self.total = int(self._starts[-1])
+        # decoded-block cache across epochs (HostBatchIterator's trick):
+        # without it every rank re-runs Arrow→numpy decode for every batch
+        # of every epoch — the dominant per-epoch host cost of a gang rank
+        self._decoded: Dict[int, Dict[str, np.ndarray]] = {}
+        self._cache_bytes = 0
+        self._cache_cap = int(float(os.environ.get(
+            "RDT_FEED_CACHE_MB", "2048")) * (1 << 20))
 
     def __len__(self) -> int:
         return self.total // self.global_batch
@@ -292,22 +299,42 @@ class GangShardIterator:
             b += 1
         return runs
 
+    def _decode_block(self, b: int) -> Dict[str, np.ndarray]:
+        cached = self._decoded.get(b)
+        if cached is not None:
+            return cached
+        table = self.dataset.get_block(b, zero_copy=True)
+        arrays = {name: _as_numpy(table, cols, dt)
+                  for name, (cols, dt) in self.columns.items()}
+        size = sum(a.nbytes for a in arrays.values())
+        if self._cache_bytes + size <= self._cache_cap:
+            # own the bytes (a zero-copy view into the store must not be
+            # cached past this iteration) and freeze them so an in-place
+            # consumer mutation fails loudly instead of poisoning epochs
+            arrays = {n: (a if a.flags["OWNDATA"] else a.copy())
+                      for n, a in arrays.items()}
+            for a in arrays.values():
+                a.setflags(write=False)
+            self._decoded[b] = arrays
+            self._cache_bytes += size
+        return arrays
+
     def __iter__(self):
         order = np.arange(len(self))
         if self.shuffle:
             np.random.RandomState(self.seed).shuffle(order)
-        tables: Dict[int, pa.Table] = {}  # zero-copy views, live for the epoch
         for k in order:
             start = int(k) * self.global_batch + self.row_range[0]
             parts = []
             for b, off, length in self._runs(start, start + self.per_rank):
-                t = tables.get(b)
-                if t is None:
-                    t = tables[b] = self.dataset.get_block(b, zero_copy=True)
-                parts.append(t.slice(off, length))
-            tbl = pa.concat_tables(parts) if len(parts) > 1 else parts[0]
-            yield {name: _as_numpy(tbl, cols, dt)
-                   for name, (cols, dt) in self.columns.items()}
+                arrays = self._decode_block(b)
+                parts.append({n: a[off:off + length]
+                              for n, a in arrays.items()})
+            if len(parts) == 1:
+                yield parts[0]
+            else:
+                yield {n: np.concatenate([p[n] for p in parts], axis=0)
+                       for n in self.columns}
 
 
 class DeviceEpochCache:
