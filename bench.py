@@ -56,13 +56,27 @@ SEQ_LEN = int(os.environ.get("BENCH_SEQ_LEN", "8192"))
 # to per-batch dispatch (tests/test_train.py chain parity)
 CHAIN = int(os.environ.get("BENCH_CHAIN", "8"))
 
-# priority order: the primary first, then the two configs no round has yet
-# recorded (gbdt, gang), then the MFU flagship; the budget trims from the end
-CONFIG_ORDER = ["nyctaxi", "gbdt", "keras", "gang", "transformer", "dlrm"]
+# priority order on a live TPU: the headline and the MFU flagship claim the
+# FIRST device window (three rounds lost their TPU numbers to wedges that
+# fired after the early budget was spent elsewhere — VERDICT r4 #1)
+CONFIG_ORDER = ["nyctaxi", "transformer", "gbdt", "dlrm", "keras", "gang"]
+#: configs that never touch the TPU (gang pins its ranks to CPU devices two
+#: processes cannot share the one chip) — always safe to run while wedged
+CPU_NATIVE = {"gang"}
+#: the must-record-on-TPU configs: while the tunnel is wedged these are
+#: DEFERRED (other configs run on the labeled CPU fallback in the meantime,
+#: with a re-probe between each) in the hope a later probe passes; they drop
+#: to the CPU fallback only when the remaining budget would otherwise expire
+TPU_PRIORITY = ("nyctaxi", "transformer")
+#: planning estimate for one scaled-down CPU-fallback run of a deferred
+#: config (r04's full CPU matrix ran ~385 s; individual configs 60-150 s)
+CPU_FALLBACK_EST_S = 150.0
 #: hard per-config wall caps (seconds) — a config that blows its cap is
-#: killed and recorded as a timeout; the matrix continues
-CONFIG_CAPS_S = {"nyctaxi": 270, "gbdt": 300, "keras": 240, "gang": 480,
-                 "transformer": 360, "dlrm": 330}
+#: killed and recorded as a timeout; the matrix continues. TPU-priority
+#: configs get one requeue after a timeout (a cold remote-tunnel compile can
+#: eat most of a cap; the persistent compile cache makes the retry cheaper).
+CONFIG_CAPS_S = {"nyctaxi": 300, "gbdt": 300, "keras": 240, "gang": 480,
+                 "transformer": 390, "dlrm": 330}
 #: total wall target; configs that do not fit inside it are skipped with an
 #: explicit marker (default chosen so the full matrix + startup stays well
 #: under the driver's budget: the round-2 matrix ran ~700 s on TPU)
@@ -672,69 +686,139 @@ def main():
     os.makedirs(cache_dir, exist_ok=True)
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
-    platform = "default"
+
+    # tpu_expected: this host SHOULD have an accelerator (the axon plugin
+    # env is present), so a failed probe means a wedged tunnel that may heal
+    # within the budget — worth re-probing — rather than hardware that will
+    # never appear
+    tpu_expected = bool(os.environ.get("PALLAS_AXON_POOL_IPS")
+                        or os.environ.get("TPU_NAME"))
+    alive = False
     if os.environ.get("BENCH_FORCE_CPU") == "1":
-        platform = "cpu(forced)"
+        cpu_label = "cpu(forced)"
+        tpu_expected = False
     else:
         probed = _probe_devices()
-        if probed is None:
-            platform = "cpu(tpu-unavailable-fallback)"
-            print("# TPU device init timed out; falling back to CPU",
-                  file=sys.stderr)
-        elif probed == "cpu":
-            # a CPU-only host (no accelerator plugin): label it honestly and
-            # scale configs down — the flagship shapes are accelerator-sized
-            platform = "cpu(host-default)"
+        if probed is not None and probed != "cpu":
+            alive = True
+            tpu_expected = True
+            cpu_label = "cpu(tpu-wedged-midrun-fallback)"  # used only post-wedge
+        elif probed == "cpu" and not tpu_expected:
+            # a genuinely CPU-only host (no accelerator plugin): label it
+            # honestly, scale configs down, and do not chase a TPU window
+            cpu_label = "cpu(host-default)"
+        else:
+            cpu_label = "cpu(tpu-unavailable-fallback)"
+            print("# TPU device probe failed at startup; deferring "
+                  "TPU-priority configs and re-probing", file=sys.stderr)
 
     selected = [c.strip() for c in os.environ.get(
         "BENCH_CONFIGS", ",".join(CONFIG_ORDER)).split(",") if c.strip()]
+    pending = ([c for c in CONFIG_ORDER if c in selected]
+               + [c for c in selected if c not in CONFIG_ORDER])
     # probe time counts against the budget (a slow-but-alive tunnel must not
     # push the matrix past the driver's wall)
     deadline = t_start + BUDGET_S
+    probe_idle_s = float(os.environ.get("BENCH_PROBE_IDLE_S", "30"))
 
     extra = {}
     primary = None
-    platform0 = platform  # the startup decision: what the HEADLINE ran on
-    for name in selected:
-        remaining = deadline - time.perf_counter()
-        if remaining < MIN_CONFIG_S:
-            skip = {"skipped": "budget",
-                    "remaining_s": round(max(remaining, 0.0), 1)}
-            extra[name] = skip
-            if name == "nyctaxi":
-                primary = skip  # a budget-dropped primary is 0.0, not "not selected"
-            print(f"# {name}: skipped (budget exhausted, "
-                  f"{remaining:.0f}s left)", file=sys.stderr)
-            continue
-        cap = min(float(CONFIG_CAPS_S.get(name, 300)), remaining)
+    attempts = {}
+    platform0 = "default" if alive else cpu_label  # the startup decision
+    midrun_fallback = midrun_promoted = False
+
+    def _run(name, platform):
+        nonlocal primary
+        attempts[name] = attempts.get(name, 0) + 1
+        cap = min(float(CONFIG_CAPS_S.get(name, 300)),
+                  deadline - time.perf_counter())
         t0 = time.perf_counter()
         result = _spawn_config(name, cap, platform)
         result["config_wall_s"] = round(time.perf_counter() - t0, 1)
-        # the platform can change mid-matrix (wedge fallback below): label
-        # each entry with what it actually ran on
         result.setdefault("platform", platform)
+        prev = extra.get(name)
+        if prev is not None and ("timeout_s" in prev or "error" in prev):
+            # a fallback rerun after a failed TPU attempt keeps the failed
+            # attempt on the record instead of silently replacing it
+            result.setdefault("prior_attempt", {
+                k: prev[k] for k in ("timeout_s", "error", "platform")
+                if k in prev})
+        extra[name] = result
         if name == "nyctaxi":
             primary = result
-        extra[name] = result
         print(f"# {name}: {result}", file=sys.stderr)
-        remaining = deadline - time.perf_counter()
-        is_last = name == selected[-1]
-        if ("timeout_s" in result and platform == "default"
-                and not is_last and remaining > MIN_CONFIG_S):
+        return result
+
+    def _reprobe(timeout_s):
+        nonlocal alive, cpu_label, midrun_fallback, midrun_promoted
+        was = alive
+        probed = _probe_devices(timeout_s=timeout_s)
+        alive = probed is not None and probed != "cpu"
+        if was and not alive:
             # the tunnel can wedge MID-matrix (observed r04: configs after
-            # the wedge hang at first device touch and burn their full caps
-            # one after another). Re-probe with a short deadline; if the
-            # chip no longer computes — a hung probe OR a dead tunnel whose
-            # plugin now falls back to host CPU — run the REST of the matrix
-            # on the labeled CPU fallback (scaled-down shapes) instead of
-            # feeding accelerator-sized configs to a dead tunnel. Skipped
-            # after the last config (nothing left to save) and when the
-            # probe itself would blow the budget.
-            probed = _probe_devices(timeout_s=min(90.0, remaining - 30.0))
-            if probed is None or probed == "cpu":
-                platform = "cpu(tpu-wedged-midrun-fallback)"
-                print("# TPU stopped computing mid-matrix; remaining "
-                      "configs fall back to CPU", file=sys.stderr)
+            # the wedge hang at first device touch and burn their caps one
+            # after another); run what remains on the labeled CPU fallback
+            cpu_label = "cpu(tpu-wedged-midrun-fallback)"
+            midrun_fallback = True
+            print("# TPU stopped computing mid-matrix; falling back to CPU",
+                  file=sys.stderr)
+        elif alive and not was:
+            midrun_promoted = True
+            print("# TPU probe passed; promoting remaining configs to TPU",
+                  file=sys.stderr)
+
+    while pending:
+        remaining = deadline - time.perf_counter()
+        if remaining < MIN_CONFIG_S:
+            for name in pending:
+                skip = {"skipped": "budget",
+                        "remaining_s": round(max(remaining, 0.0), 1)}
+                # keep a recorded failed attempt over a bare skip marker
+                extra.setdefault(name, skip)
+                if name == "nyctaxi" and primary is None:
+                    primary = extra[name]  # budget-dropped primary = 0.0
+                print(f"# {name}: skipped (budget exhausted, "
+                      f"{remaining:.0f}s left)", file=sys.stderr)
+            break
+        if alive:
+            name = pending.pop(0)
+            result = _run(name, "default")
+            remaining = deadline - time.perf_counter()
+            if ("timeout_s" in result and pending
+                    and remaining > MIN_CONFIG_S + 30.0):
+                _reprobe(min(90.0, remaining - 30.0))
+                if name in TPU_PRIORITY and attempts.get(name, 0) < 2:
+                    # one requeue: on a live TPU the retry rides the compile
+                    # cache the killed attempt already warmed; after a wedge
+                    # it gets the CPU fallback so the record isn't empty
+                    pending.append(name)
+            continue
+        if not tpu_expected:
+            _run(pending.pop(0), cpu_label)
+            continue
+        # wedged, but the tunnel may heal: run the CPU-useful configs now
+        # (re-probing between them) and spend idle budget waiting before
+        # surrendering the TPU-priority configs to the CPU fallback
+        prio = [c for c in pending if c in TPU_PRIORITY]
+        reserve = CPU_FALLBACK_EST_S * len(prio) + 90.0
+        # CPU-native configs first (they lose nothing to the fallback), then
+        # the remaining non-priority configs
+        idx = next((i for i, c in enumerate(pending) if c in CPU_NATIVE),
+                   next((i for i, c in enumerate(pending)
+                         if c not in TPU_PRIORITY), None))
+        cap_next = (min(float(CONFIG_CAPS_S.get(pending[idx], 300)), remaining)
+                    if idx is not None else 0.0)
+        if idx is not None and remaining - cap_next >= reserve:
+            _run(pending.pop(idx), cpu_label)
+            if prio and deadline - time.perf_counter() > MIN_CONFIG_S + 60.0:
+                _reprobe(60.0)
+        elif prio and remaining >= reserve + 120.0:
+            # nothing CPU-useful fits beside the reserve: wait on the tunnel
+            _reprobe(90.0)
+            if not alive:
+                time.sleep(probe_idle_s)
+        else:
+            _run(pending.pop(0), cpu_label)
 
     out = {
         "metric": "nyctaxi_e2e_train_samples_per_sec_per_chip",
@@ -750,7 +834,9 @@ def main():
         "baseline_note": "self-measured reference workload, torch CPU "
                          f"batch 8192 ({REF_NYCTAXI_B8192:.0f} samples/s; "
                          f"batch-64-as-shipped: {REF_NYCTAXI_B64:.0f})",
-        **({"platform_midrun_fallback": platform} if platform != platform0
+        **({"platform_midrun_fallback": cpu_label} if midrun_fallback
+           else {}),
+        **({"platform_midrun_promoted": "default"} if midrun_promoted
            else {}),
         "extra": extra,
     }
