@@ -59,7 +59,8 @@ CHAIN = int(os.environ.get("BENCH_CHAIN", "8"))
 # priority order on a live TPU: the headline and the MFU flagship claim the
 # FIRST device window (three rounds lost their TPU numbers to wedges that
 # fired after the early budget was spent elsewhere — VERDICT r4 #1)
-CONFIG_ORDER = ["nyctaxi", "transformer", "gbdt", "dlrm", "keras", "gang"]
+CONFIG_ORDER = ["nyctaxi", "transformer", "gbdt", "dlrm", "dlrm_stream",
+                "keras", "gang"]
 #: configs that never touch the TPU (gang pins its ranks to CPU devices two
 #: processes cannot share the one chip) — always safe to run while wedged
 CPU_NATIVE = {"gang"}
@@ -76,7 +77,7 @@ CPU_FALLBACK_EST_S = 150.0
 #: configs get one requeue after a timeout (a cold remote-tunnel compile can
 #: eat most of a cap; the persistent compile cache makes the retry cheaper).
 CONFIG_CAPS_S = {"nyctaxi": 300, "gbdt": 300, "keras": 240, "gang": 480,
-                 "transformer": 390, "dlrm": 330}
+                 "transformer": 390, "dlrm": 330, "dlrm_stream": 330}
 #: total wall target; configs that do not fit inside it are skipped with an
 #: explicit marker (default chosen so the full matrix + startup stays well
 #: under the driver's budget: the round-2 matrix ran ~700 s on TPU)
@@ -287,6 +288,20 @@ def bench_dlrm() -> dict:
         raydp_tpu.stop()
 
 
+# ---------------------------------------------------------------- dlrm_stream
+def bench_dlrm_stream() -> dict:
+    """The HBM-overflow regime: the residency gate forced off, so training
+    runs through the streaming DeviceFeed (background host decode + chained
+    per-dispatch transfers) instead of the resident epoch cache — the
+    realistic Criteo-at-scale case where the dataset cannot live in HBM
+    (reference examples/pytorch_dlrm.ipynb; VERDICT r4 Weak #5). The
+    feed/dispatch/sync split in the entry is the host-boundness evidence."""
+    os.environ["RDT_DEVICE_CACHE"] = "0"
+    out = bench_dlrm()
+    out["streaming_forced"] = True
+    return out
+
+
 # ---------------------------------------------------------------------- keras
 def bench_keras() -> dict:
     os.environ.setdefault("KERAS_BACKEND", "jax")
@@ -397,12 +412,26 @@ def bench_gang() -> dict:
     What this sweep can and cannot show: this host exposes ONE schedulable
     CPU core (``os.sched_getaffinity`` = {0}), so every rank process
     timeshares that core and aggregate compute is constant at any width —
-    rank scaling >1.0 is physically impossible here. The honest claim is the
-    inverse: ``scaling`` near 1.0 at 2/4 ranks means the gang machinery
-    (fan-out, feed sharding, cross-process psum) adds little overhead, which
-    is the property that transfers to real multi-host meshes where each rank
-    owns its own cores/chips. ``host_cpus`` is recorded so the reader can
-    tell which regime produced the number.
+    rank scaling >1.0 is physically impossible here. The r4 sweep recorded
+    ~0.5 at 2 ranks and the r5 diagnosis isolated the mechanism
+    (benchmarks/gang_collective_microbench.py): the per-step XLA-inserted
+    gradient all-reduces cost ~90 ms/step in-process and ~192 ms/step the
+    moment they cross a process boundary on this host's loopback distributed
+    backend — a +102 ms/step cost matching the sweep's observed steady
+    per-step delta (+96 ms/step), amplified by both ranks timesharing the
+    one core (a rank's collective busy-wait competes with its peer's
+    compute). It is NOT duplicated per-rank work: the steady clock excludes
+    the compile epoch, and ``feed_s`` stays ~0.01 s/epoch at every width
+    (the decoded-block cache works). The honest criterion recorded in
+    ``scaling_note``: the train loop's 2-rank per-step delta should agree
+    with the in-run pure-psum microbench delta within the timeshared core's
+    noise band (``collective_mechanism_ratio`` in [0.33, 3]); a ratio far
+    beyond that would indicate real gang-machinery waste. On a real
+    multi-host TPU mesh the same all-reduces ride ICI at
+    hardware bandwidth and overlap compute, so this loopback cost does not
+    transfer. Per-width entries carry ``first_epoch_wall_s`` (compile) vs
+    ``steady_epoch_wall_s`` and the feed split so the reader can audit the
+    clock.
     """
     import optax
 
@@ -441,6 +470,7 @@ def bench_gang() -> dict:
                 batch_size=min(BATCH, 4096),
                 num_epochs=3,
                 shuffle=False,
+                steps_per_dispatch=CHAIN,
             )
             t0 = time.perf_counter()
             result = est.fit_gang(
@@ -452,22 +482,82 @@ def bench_gang() -> dict:
                     # keep ranks off the TPU tunnel
                     "PALLAS_AXON_POOL_IPS": None,
                 })
-            sweep[workers] = {
-                "samples_per_s": round(_steady(result.history), 1),
-                "final_loss": result.history[-1].get("train_loss"),
+            hist = result.history
+            steady = hist[1:] or hist
+            entry = {
+                "samples_per_s": round(_steady(hist), 1),
+                "final_loss": hist[-1].get("train_loss"),
                 "wall_s": round(time.perf_counter() - t0, 1),
+                # compile vs steady separation (VERDICT r4 #2): the first
+                # epoch carries each rank's jit compile; the steady clock
+                # never includes it
+                "first_epoch_wall_s": round(hist[0]["epoch_time_s"], 2),
+                "steady_epoch_wall_s": round(
+                    sum(r["epoch_time_s"] for r in steady) / len(steady), 2),
+                "steps_per_epoch": hist[-1].get("steps"),
             }
+            entry.update(_feed_split(hist))
+            sweep[workers] = entry
         base = sweep[1]["samples_per_s"] or 1.0
+        steps = float(sweep[1].get("steps_per_epoch") or 1)
+        base_step_ms = sweep[1]["steady_epoch_wall_s"] / steps * 1e3
+        # per-step cost each width's cross-process all-reduces added to the
+        # TRAIN loop (derived from the steady epoch walls) ...
+        collective_delta_ms = {
+            str(w): round(
+                (v["steady_epoch_wall_s"] - sweep[1]["steady_epoch_wall_s"])
+                / steps * 1e3, 1)
+            for w, v in sweep.items()}
         out = {"samples_per_s_gang": sweep[2]["samples_per_s"],
                "devices": 8, "platform": "cpu-gang", "rows": rows,
                "host_cpus": host_cpus,
                "sweep": {str(w): v for w, v in sweep.items()},
                "scaling": {str(w): round(v["samples_per_s"] / base, 3)
                            for w, v in sweep.items()},
-               "scaling_note": (
-                   "single-core host: all ranks timeshare one CPU, so >1.0 "
-                   "scaling is impossible; ~1.0 = gang overhead is small"
-                   if host_cpus <= 1 else "")}
+               "collective_delta_ms_per_step": collective_delta_ms}
+        # ... versus the INDEPENDENT measurement: the same gradient-leaf psum
+        # pattern with zero model compute (benchmarks/
+        # gang_collective_microbench.py), run fresh here at 1 and 2 ranks.
+        # The non-circular criterion: the train loop's 2-rank delta should
+        # match the pure-collective delta — overhead beyond it would be real
+        # gang-machinery waste (duplicated feed/decode/compile work), which
+        # feed_s and the first_epoch/steady split also rule out directly.
+        try:
+            import importlib.util as _ilu
+            spec = _ilu.spec_from_file_location(
+                "gang_collective_microbench",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "benchmarks", "gang_collective_microbench.py"))
+            micro = _ilu.module_from_spec(spec)
+            spec.loader.exec_module(micro)
+            ms1, ms2 = micro.measure(1), micro.measure(2)
+            psum_delta = max(ms2 - ms1, 1e-6)
+            out["psum_microbench_ms_per_step"] = {
+                "1": round(ms1, 1), "2": round(ms2, 1)}
+            out["scaling_predicted_by_collective_latency"] = round(
+                base_step_ms / (base_step_ms + psum_delta), 3)
+            # train-loop delta vs pure-collective delta at 2 ranks: ~1 means
+            # the scaling loss IS collective latency; the band is wide
+            # because a timeshared core adds +/-2-3x run-to-run noise to
+            # latency-bound measurements (observed across r5 runs: 96-194
+            # ms/step train delta, 66-102 ms/step psum delta)
+            out["collective_mechanism_ratio"] = round(
+                float(collective_delta_ms["2"]) / psum_delta, 2)
+        except Exception as e:  # noqa: BLE001 - the sweep stands alone
+            out["psum_microbench_error"] = f"{type(e).__name__}: {e}"[:200]
+        out["scaling_note"] = (
+            "single-core host: ranks timeshare one CPU, so >1.0 scaling is "
+            "impossible; the loss is per-step cross-process all-reduce "
+            "latency, measured independently by the in-run psum microbench "
+            "(zero model compute, same gradient leaves/mesh — "
+            "benchmarks/gang_collective_microbench.py). Criterion: "
+            "'collective_mechanism_ratio' (train-loop 2-rank delta / pure-"
+            "psum delta) within [0.33, 3] = the scaling loss is collective "
+            "latency within this host's timesharing noise band; a ratio far "
+            "above 3 would be real gang-machinery overhead (duplicated "
+            "feed/decode/compile), which feed_s ~0 and the "
+            "first_epoch/steady split independently rule out"
+            if host_cpus <= 1 else "")
         return out
     finally:
         raydp_tpu.stop()
@@ -589,37 +679,71 @@ def bench_transformer() -> dict:
     Transient (non-OOM) failures retry once: the remote compile helper is
     known to flake (HTTP 500 / truncated body).
     """
-    out = {}
-    for mode in ("flash", "dense"):
+    t_start = time.perf_counter()
+    cap = float(os.environ.get("RDT_BENCH_CAP_S", "0") or 0)
+
+    def _one(mode: str, fused: Optional[str] = None) -> dict:
         t_mode = SEQ_LEN
         transient_retries = 1
-        while True:
-            try:
-                entry = _lm_mode_run(mode, t_mode)
-                break
-            except Exception as e:  # noqa: BLE001 - per-mode isolation
-                msg = str(e)
-                oom = ("RESOURCE_EXHAUSTED" in msg or "hbm" in msg
-                       or "out of memory" in msg.lower()
-                       or "Ran out of memory" in msg)
-                if oom and t_mode > 1024:
-                    out.setdefault(f"{mode}_oom_at_seq_len", t_mode)
-                    t_mode //= 2
-                    continue
-                if not oom and transient_retries > 0:
-                    transient_retries -= 1
-                    continue
-                entry = {"error": f"{type(e).__name__}: {msg[:300]}",
-                         "seq_len": t_mode}
-                break
-        out[mode] = entry
+        prev = os.environ.get("BENCH_LM_FUSED")
+        if fused is not None:
+            os.environ["BENCH_LM_FUSED"] = fused
+        try:
+            while True:
+                try:
+                    entry = _lm_mode_run(mode, t_mode)
+                    if fused is not None:
+                        entry["fused_ce"] = fused
+                    return entry
+                except Exception as e:  # noqa: BLE001 - per-mode isolation
+                    msg = str(e)
+                    oom = ("RESOURCE_EXHAUSTED" in msg or "hbm" in msg
+                           or "out of memory" in msg.lower()
+                           or "Ran out of memory" in msg)
+                    if oom and t_mode > 1024:
+                        out.setdefault(f"{mode}_oom_at_seq_len", t_mode)
+                        t_mode //= 2
+                        continue
+                    if not oom and transient_retries > 0:
+                        transient_retries -= 1
+                        continue
+                    return {"error": f"{type(e).__name__}: {msg[:300]}",
+                            "seq_len": t_mode}
+        finally:
+            if fused is not None:
+                if prev is None:
+                    os.environ.pop("BENCH_LM_FUSED", None)
+                else:
+                    os.environ["BENCH_LM_FUSED"] = prev
+
+    out = {}
+    for mode in ("flash", "dense"):
+        out[mode] = _one(mode)
+        # checkpoint the measured-so-far matrix: the parent keeps the LAST
+        # marker line, and salvages it from partial stdout on a cap kill —
+        # a later mode's compile stall can no longer cost these entries
+        print(RESULT_MARK + json.dumps(out), flush=True)
+    # the named open item from ROOFLINE_LM.md: chunked fused CE WITHOUT remat
+    # (bf16 chunk logits kept for backward — no lm_head recompute), never yet
+    # measured because its cold compile outlived the r4 tunnel. Run it last
+    # (the checkpoint line above protects flash/dense) and only with at
+    # least ~240s of cap left — the observed cold-compile ceiling on the
+    # remote compile service; skip on the CPU fallback (its scaled-down
+    # shape says nothing about the HBM/FLOPs trade).
+    if not _on_cpu():
+        if cap and cap - (time.perf_counter() - t_start) < 240.0:
+            out["flash_fused2"] = {"skipped": "under 240s of cap left for a "
+                                              "possibly-cold compile"}
+        else:
+            out["flash_fused2"] = _one("flash", fused="2")
     return out
 
 
 # ------------------------------------------------------------ child execution
 CONFIG_FNS = {"nyctaxi": bench_nyctaxi, "dlrm": bench_dlrm,
-              "keras": bench_keras, "transformer": bench_transformer,
-              "gbdt": bench_gbdt, "gang": bench_gang}
+              "dlrm_stream": bench_dlrm_stream, "keras": bench_keras,
+              "transformer": bench_transformer, "gbdt": bench_gbdt,
+              "gang": bench_gang}
 
 
 def _run_config_child(name: str) -> None:
@@ -645,6 +769,7 @@ def _spawn_config(name: str, cap_s: float, platform: str) -> dict:
     """Run one config in its own process group under a hard wall cap."""
     env = dict(os.environ)
     env["RDT_BENCH_PLATFORM"] = platform
+    env["RDT_BENCH_CAP_S"] = str(cap_s)  # children pace optional extras by it
     if platform != "default":
         # belt and braces beside the child's in-process config.update; also
         # keep the TPU plugin from even loading (a plugin touch can hang on
@@ -657,18 +782,33 @@ def _spawn_config(name: str, cap_s: float, platform: str) -> dict:
         [sys.executable, os.path.abspath(__file__), "--config", name],
         stdout=subprocess.PIPE, stderr=None, text=True, env=env,
         start_new_session=True)
+    timed_out = False
     try:
         out, _ = proc.communicate(timeout=cap_s)
     except subprocess.TimeoutExpired:
+        timed_out = True
         _kill_group(proc)
-        return {"timeout_s": cap_s,
-                "error": f"config exceeded its {cap_s:.0f}s wall cap"}
+        try:  # collect what the child printed before the kill: configs
+            # checkpoint partial results on marker lines as they measure
+            out, _ = proc.communicate(timeout=5)
+        except Exception:  # noqa: BLE001 - unreapable child
+            out = ""
+    result = None
     for line in (out or "").splitlines():
         if line.startswith(RESULT_MARK):
-            try:
-                return json.loads(line[len(RESULT_MARK):])
+            try:  # LAST marker line wins (incremental checkpoints)
+                result = json.loads(line[len(RESULT_MARK):])
             except ValueError:
-                break
+                continue
+    if timed_out:
+        timeout_info = {"timeout_s": cap_s,
+                        "error": f"config exceeded its {cap_s:.0f}s wall cap"}
+        if result is not None:
+            result.update(timeout_info, partial=True)
+            return result
+        return timeout_info
+    if result is not None:
+        return result
     return {"error": f"config subprocess rc={proc.returncode}, "
                      "no result line"}
 
@@ -739,10 +879,15 @@ def main():
         prev = extra.get(name)
         if prev is not None and ("timeout_s" in prev or "error" in prev):
             # a fallback rerun after a failed TPU attempt keeps the failed
-            # attempt on the record instead of silently replacing it
-            result.setdefault("prior_attempt", {
-                k: prev[k] for k in ("timeout_s", "error", "platform")
-                if k in prev})
+            # attempt on the record instead of silently replacing it — and a
+            # salvaged PARTIAL attempt (e.g. TPU flash/dense measured before
+            # a fused2 compile stall) is kept whole: a CPU-fallback rerun
+            # must not erase real device numbers
+            result.setdefault(
+                "prior_attempt",
+                prev if prev.get("partial") else {
+                    k: prev[k] for k in ("timeout_s", "error", "platform")
+                    if k in prev})
         extra[name] = result
         if name == "nyctaxi":
             primary = result
@@ -784,9 +929,9 @@ def main():
             name = pending.pop(0)
             result = _run(name, "default")
             remaining = deadline - time.perf_counter()
-            if ("timeout_s" in result and pending
-                    and remaining > MIN_CONFIG_S + 30.0):
-                _reprobe(min(90.0, remaining - 30.0))
+            if "timeout_s" in result and remaining > MIN_CONFIG_S + 30.0:
+                if pending:
+                    _reprobe(min(90.0, remaining - 30.0))
                 if name in TPU_PRIORITY and attempts.get(name, 0) < 2:
                     # one requeue: on a live TPU the retry rides the compile
                     # cache the killed attempt already warmed; after a wedge

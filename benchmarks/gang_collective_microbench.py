@@ -1,0 +1,103 @@
+"""Isolate the gang sweep's cross-process collective cost (r5 diagnosis).
+
+The bench ``gang`` config records steady scaling ~0.5 at 2 ranks on this
+host. This microbench shows why, with zero model compute: the same global
+8-device mesh, one ``psum`` per NYCTaxi-MLP-gradient-sized leaf per step
+(the collective pattern GSPMD inserts for data-parallel gradients), scanned
+232 steps (29 steps/epoch x chain 8).
+
+Measured on the 1-core build host (2026-07-31):
+
+    workers=1: 20.8 s  (89.6 ms/step)   in-process, 8 virtual devices
+    workers=2: 44.5 s (191.7 ms/step)   4 virtual devices per rank
+
+The +102 ms/step from crossing the process boundary matches the gang
+sweep's observed steady per-step delta (+96 ms/step at 2 ranks) — the
+scaling loss is per-step all-reduce latency over the loopback distributed
+backend (amplified by both ranks timesharing one core, where a rank's
+collective busy-wait competes with its peer's compute), NOT duplicated
+per-rank feed or compile work (feed_s is ~0.01 s/epoch at every width and
+compile is excluded from the steady clock). On a real multi-host TPU mesh
+the same all-reduces ride ICI at hardware bandwidth and overlap compute.
+
+Run: python benchmarks/gang_collective_microbench.py
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.pop("TPU_NAME", None)
+
+from raydp_tpu.spmd.job import create_spmd_job
+
+STEPS = 232  # 29 steps/epoch x chain 8, one bench-gang epoch equivalent
+
+
+def rank_fn(ctx):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("d",))
+    # the NYCTaxi MLP's gradient leaves (kernels, biases, BN scales/offsets)
+    sizes = [13 * 256, 256, 256, 256, 256 * 128, 128, 128, 128,
+             128 * 64, 64, 64, 64 * 32, 32, 32 * 1]
+    tree = [jnp.ones((s,), jnp.float32) for s in sizes]
+
+    def allreduce(*leaves):
+        return tuple(jax.lax.psum(leaf, "d") for leaf in leaves)
+
+    ar = shard_map(allreduce, mesh=mesh,
+                   in_specs=tuple(P() for _ in sizes),
+                   out_specs=tuple(P() for _ in sizes))
+
+    @jax.jit
+    def run(tree):
+        def body(c, _):
+            out = ar(*c)
+            return [o / mesh.size for o in out], None
+
+        c, _ = jax.lax.scan(body, tree, None, length=STEPS)
+        return c
+
+    jax.block_until_ready(run(tree))  # compile + warm
+    t0 = time.perf_counter()
+    jax.block_until_ready(run(tree))
+    dt = time.perf_counter() - t0
+    return {"rank": ctx.rank, "steps": STEPS, "wall_s": dt,
+            "ms_per_step": dt / STEPS * 1e3}
+
+
+def measure(workers: int, devices: int = 8, timeout: float = 600.0) -> float:
+    """ms/step of the pure-collective scan at ``workers`` rank processes over
+    a fixed ``devices``-wide global mesh (chief rank's clock)."""
+    job = create_spmd_job(
+        f"psum{workers}", workers, jax_distributed=True,
+        env={"JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count="
+                          f"{devices // workers}",
+             "PALLAS_AXON_POOL_IPS": None})
+    job.start()
+    try:
+        res = job.run(rank_fn, timeout=timeout)
+    finally:
+        job.stop()
+    return float(res[0]["ms_per_step"])
+
+
+def main():
+    for workers in (1, 2):
+        ms = measure(workers)
+        print(f"workers={workers}: {ms:.2f} ms/step "
+              f"({ms * STEPS / 1e3:.2f}s over {STEPS} steps)")
+
+
+if __name__ == "__main__":
+    main()

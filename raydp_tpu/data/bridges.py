@@ -63,6 +63,16 @@ def to_torch_dataset(ds, feature_columns: Sequence[str],
     of the native feed apply unchanged; ``world_size``/``rank`` select one
     balanced shard for DDP-style consumers (``divide_blocks`` parity,
     reference utils.py:149-222).
+
+    Determinism note: with multi-worker loaders the per-epoch shuffle signal
+    is derived from torch's worker seeding convention (``info.seed -
+    info.id`` = the loader's per-epoch base seed), so the shuffle order is
+    reproducible across runs only when the ``DataLoader``'s ``generator`` is
+    explicitly seeded; workers always AGREE within a run either way (the
+    stripe split needs all workers on one order). A custom ``worker_init_fn``
+    that reseeds torch does not break agreement, only cross-run
+    reproducibility. The native ``DeviceFeed.set_epoch`` path has no such
+    dependence.
     """
     import torch
     from torch.utils.data import IterableDataset

@@ -299,15 +299,27 @@ class GangShardIterator:
             b += 1
         return runs
 
-    def _decode_block(self, b: int) -> Dict[str, np.ndarray]:
+    def _decoded_nbytes(self, rows: int) -> int:
+        """Exact decoded size of ``rows`` rows under this iterator's fixed-
+        width column specs — lets cache eligibility be decided WITHOUT
+        decoding the block first."""
+        return rows * sum(len(cols) * dt.itemsize
+                          for cols, dt in self.columns.values())
+
+    def _decode_run(self, b: int, off: int,
+                    length: int) -> Dict[str, np.ndarray]:
+        """Rows ``[off, off+length)`` of block ``b``: served from the decoded
+        cache when the block fits the ``RDT_FEED_CACHE_MB`` budget; otherwise
+        only the requested slice is decoded (``table.slice`` is zero-copy),
+        so an over-cap gang feed pays O(batch) — not O(block) — Arrow→numpy
+        work per batch (mirrors ``HostBatchIterator._decode_slice``)."""
         cached = self._decoded.get(b)
-        if cached is not None:
-            return cached
-        table = self.dataset.get_block(b, zero_copy=True)
-        arrays = {name: _as_numpy(table, cols, dt)
-                  for name, (cols, dt) in self.columns.items()}
-        size = sum(a.nbytes for a in arrays.values())
-        if self._cache_bytes + size <= self._cache_cap:
+        if cached is None and (self._cache_bytes
+                               + self._decoded_nbytes(self._block_rows(b))
+                               <= self._cache_cap):
+            table = self.dataset.get_block(b, zero_copy=True)
+            arrays = {name: _as_numpy(table, cols, dt)
+                      for name, (cols, dt) in self.columns.items()}
             # own the bytes (a zero-copy view into the store must not be
             # cached past this iteration) and freeze them so an in-place
             # consumer mutation fails loudly instead of poisoning epochs
@@ -315,9 +327,16 @@ class GangShardIterator:
                       for n, a in arrays.items()}
             for a in arrays.values():
                 a.setflags(write=False)
-            self._decoded[b] = arrays
-            self._cache_bytes += size
-        return arrays
+            cached = self._decoded[b] = arrays
+            self._cache_bytes += sum(a.nbytes for a in arrays.values())
+        if cached is not None:
+            return {n: a[off:off + length] for n, a in cached.items()}
+        table = self.dataset.get_block(b, zero_copy=True).slice(off, length)
+        return {name: _as_numpy(table, cols, dt)
+                for name, (cols, dt) in self.columns.items()}
+
+    def _block_rows(self, b: int) -> int:
+        return int(self._starts[b + 1] - self._starts[b])
 
     def __iter__(self):
         order = np.arange(len(self))
@@ -327,9 +346,7 @@ class GangShardIterator:
             start = int(k) * self.global_batch + self.row_range[0]
             parts = []
             for b, off, length in self._runs(start, start + self.per_rank):
-                arrays = self._decode_block(b)
-                parts.append({n: a[off:off + length]
-                              for n, a in arrays.items()})
+                parts.append(self._decode_run(b, off, length))
             if len(parts) == 1:
                 yield parts[0]
             else:

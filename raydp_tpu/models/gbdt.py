@@ -227,7 +227,12 @@ def _boost_chunk(Xb, y, w, pred, *, chunk: int, max_depth: int, num_bins: int,
 
 def _eval_metric_value(margin, y, objective: str):
     """In-jit twin of :func:`eval_metric`'s value (same formulas, jnp ops) —
-    what the fused train+eval scan accumulates per round."""
+    what the fused train+eval scan accumulates per round.
+
+    KEEP IN SYNC with :func:`eval_metric` (host numpy/float64): the
+    early-stopping path consumes that host version, and the two histories
+    are pinned together by tests/test_gbdt.py's fused-eval parity test
+    (rtol 1e-5) — edit both or that test fails."""
     if objective == "binary:logistic":
         p = 1.0 / (1.0 + jnp.exp(-margin))
         eps = 1e-7
@@ -304,7 +309,11 @@ def predict_binned(Xb, split_feature, split_bin, leaf_value,
 
 def eval_metric(margin: np.ndarray, y: np.ndarray,
                 objective: str) -> Tuple[str, float]:
-    """The objective's default metric (xgboost naming)."""
+    """The objective's default metric (xgboost naming).
+
+    KEEP IN SYNC with :func:`_eval_metric_value` (the in-jit jnp/float32
+    twin the fused boosting scan accumulates); the parity test in
+    tests/test_gbdt.py pins the pair at rtol 1e-5."""
     if objective == "binary:logistic":
         p = 1.0 / (1.0 + np.exp(-margin))
         eps = 1e-7

@@ -104,6 +104,22 @@ def _latest_agreed(ckpt_dir: str, max_step: Optional[int] = None
         "shared storage visible to every rank")
 
 
+def warn_if_reused_dir(ckpt_dir: str) -> None:
+    """A fresh (non-resume) fit pointed at a dir that already holds ``step_*``
+    checkpoints: retention and retry-restore are scoped to THIS run's steps
+    (``_latest_agreed(max_step=...)``), but a later explicit resume or
+    ``restore()`` without ``max_step`` would silently prefer the foreign
+    higher-numbered steps — tell the user the dir is reused up front."""
+    steps = _step_dirs(ckpt_dir, complete_only=False)
+    if steps:
+        logger.warning(
+            "checkpoint_dir %r already contains %d step_* checkpoint dir(s) "
+            "(latest: step_%d) from an earlier run; this fit will not adopt "
+            "them, but a later resume/restore() on this dir would — use a "
+            "fresh checkpoint_dir per run to keep runs separate",
+            ckpt_dir, len(steps), steps[-1][0])
+
+
 def ensure_shared_dir(ckpt_dir: str, tag: str) -> None:
     """Gang-startup probe: the chief creates ``ckpt_dir``; every other rank
     must see it after a barrier, else the gang runs on per-host paths and a

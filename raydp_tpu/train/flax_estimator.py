@@ -268,6 +268,8 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         from raydp_tpu.parallel import batch_sharding, param_sharding_rules
         from raydp_tpu.train import checkpoint as ckpt
 
+        if not resume and self.checkpoint_dir:
+            ckpt.warn_if_reused_dir(ckpt_dir)
         model = self._build_model()
         tx = self._build_optimizer()
         loss_fn = _resolve_loss(self._loss)
@@ -634,6 +636,13 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
             raise ValueError("fit_gang builds its mesh inside the ranks; "
                              "pass mesh_spec instead of a driver-built mesh")
         ckpt_dir = self.checkpoint_dir or tempfile.mkdtemp(prefix="rdt-gang-")
+        if self.checkpoint_dir:
+            # gang ranks run with resume=True by design (the restart loop
+            # below depends on it), so THIS is the one path where a fresh fit
+            # pointed at a reused dir silently ADOPTS the earlier run's
+            # latest step — warn before the ranks start
+            from raydp_tpu.train.checkpoint import warn_if_reused_dir
+            warn_if_reused_dir(ckpt_dir)
         train_payload = train_ds.portable()
         eval_payload = evaluate_ds.portable() if evaluate_ds is not None else None
 

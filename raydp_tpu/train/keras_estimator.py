@@ -313,6 +313,16 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
         history: list = []
         epoch0 = 0
         restored = False
+        if not resume and self.checkpoint_dir and _ckpt_available():
+            # the flax twin's reused-dir warning (checkpoint.warn_if_reused_dir)
+            # for the keras model.keras/state.json format: this fit will
+            # overwrite, but the user should learn the dir held an earlier
+            # run before a later resume silently adopts whichever run wrote
+            # last
+            logger.warning(
+                "checkpoint_dir %r already holds a model.keras/state.json "
+                "from an earlier run; this fit overwrites them — use a fresh "
+                "checkpoint_dir per run to keep runs separate", ckpt_dir)
         if resume:
             # gang: all ranks must resume the SAME epoch or their collective
             # counts diverge and the first psum deadlocks — take the CHIEF's

@@ -202,3 +202,69 @@ def test_last_config_timeout_skips_reprobe(bench, monkeypatch, capsys):
     out = _run_main(bench, capsys)
     assert probe_calls["n"] == 1  # the startup probe only
     assert out["value"] == 0.0
+
+
+class _FakeProc:
+    """Popen stub: first communicate may raise TimeoutExpired; the retry
+    returns whatever stdout the child had printed before the kill."""
+
+    pid = 4242
+    returncode = 0
+
+    def __init__(self, stdout, timeout_first=False):
+        self._stdout = stdout
+        self._timeout_first = timeout_first
+
+    def communicate(self, timeout=None):
+        import subprocess
+        if self._timeout_first:
+            self._timeout_first = False
+            raise subprocess.TimeoutExpired(cmd="fake", timeout=timeout)
+        return self._stdout, ""
+
+
+def test_spawn_config_last_marker_line_wins(bench, monkeypatch):
+    """Configs checkpoint partial matrices as marker lines; the final
+    (most complete) line is the result."""
+    lines = (bench.RESULT_MARK + json.dumps({"flash": 1}) + "\n"
+             + bench.RESULT_MARK + json.dumps({"flash": 1, "dense": 2}) + "\n")
+    monkeypatch.setattr(bench.subprocess, "Popen",
+                        lambda *a, **k: _FakeProc(lines))
+    out = bench._spawn_config("transformer", 60.0, "default")
+    assert out == {"flash": 1, "dense": 2}
+
+
+def test_spawn_config_salvages_partial_on_cap_kill(bench, monkeypatch):
+    """A cap kill mid-config keeps the entries measured before the stall
+    (code-review r5: a fused2 compile stall must not erase flash/dense)."""
+    lines = bench.RESULT_MARK + json.dumps({"flash": {"mfu": 0.59}}) + "\n"
+    monkeypatch.setattr(bench.subprocess, "Popen",
+                        lambda *a, **k: _FakeProc(lines, timeout_first=True))
+    monkeypatch.setattr(bench, "_kill_group", lambda proc: None)
+    out = bench._spawn_config("transformer", 60.0, "default")
+    assert out["flash"] == {"mfu": 0.59}
+    assert out["partial"] is True and out["timeout_s"] == 60.0
+
+
+def test_partial_tpu_results_survive_fallback_rerun(bench, monkeypatch,
+                                                    capsys):
+    """When a salvaged-partial TPU attempt is requeued and rerun, the rerun
+    keeps the WHOLE partial (real device numbers) as prior_attempt."""
+    monkeypatch.setenv("BENCH_CONFIGS", "transformer")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    calls = {"n": 0}
+
+    def fake_spawn(name, cap_s, platform):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return {"flash": {"mfu": 0.59}, "partial": True,
+                    "timeout_s": cap_s, "error": "wall cap"}
+        return {"flash": {"mfu": 0.6}, "dense": {"mfu": 0.2}}
+
+    monkeypatch.setattr(bench, "_spawn_config", fake_spawn)
+    monkeypatch.setattr(bench, "_probe_devices", lambda timeout_s=None: "tpu")
+
+    out = _run_main(bench, capsys)
+    entry = out["extra"]["transformer"]
+    assert entry["dense"] == {"mfu": 0.2}
+    assert entry["prior_attempt"]["flash"] == {"mfu": 0.59}

@@ -74,3 +74,24 @@ def test_restore_reads_sharded_format_single_process(tmp_path):
     state, step = restored
     assert step == 3
     np.testing.assert_array_equal(state["w"], full)
+
+
+def test_warn_if_reused_dir(tmp_path):
+    """A fresh fit pointed at a dir holding an earlier run's step_* dirs must
+    say so up front (advisor r4): retention/retry are scoped to this run, but
+    a later resume without max_step would adopt the foreign steps silently."""
+    import logging
+
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append  # the package logger has propagate=False
+    lg = logging.getLogger("raydp_tpu.train.checkpoint")
+    lg.addHandler(handler)
+    try:
+        ckpt.warn_if_reused_dir(str(tmp_path))        # empty: silent
+        assert not records
+        (tmp_path / "step_7").mkdir()                 # even a torn dir counts
+        ckpt.warn_if_reused_dir(str(tmp_path))
+        assert any("already contains" in r.getMessage() for r in records)
+    finally:
+        lg.removeHandler(handler)

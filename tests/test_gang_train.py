@@ -149,3 +149,50 @@ def test_gang_iterator_covers_rows_exactly_once():
             got.extend(batch["x"].tolist())
     # 3 global batches x 16 rows = rows 0..47 exactly once across both ranks
     assert sorted(got) == list(range(48))
+
+
+def test_gang_iterator_over_cap_decodes_slices_not_blocks(monkeypatch):
+    """A block that exceeds the RDT_FEED_CACHE_MB budget is never decoded
+    whole per batch: the iterator slices the Arrow table to the requested
+    rows first, so over-cap feeds pay O(batch) decode work (advisor r4)."""
+    import pyarrow as pa
+
+    from raydp_tpu.data.feed import GangShardIterator
+
+    rows = np.arange(64, dtype=np.float64)
+    table = pa.table({"x": rows})
+    log = []
+
+    class _SpyTable:
+        def slice(self, off, n):
+            log.append(("slice", off, n))
+            return table.slice(off, n)
+
+        def column(self, c):
+            log.append(("full-decode", c))
+            return table.column(c)
+
+    class _Ds:
+        def block_sizes(self):
+            return [64]
+
+        def get_block(self, i, zero_copy=False):
+            return _SpyTable()
+
+    def run():
+        log.clear()
+        it = GangShardIterator(_Ds(), global_batch=16, world_size=2, rank=0,
+                               columns={"x": ("x", np.float64)})
+        out = [b["x"].copy() for b in it]
+        return np.concatenate(out)
+
+    monkeypatch.setenv("RDT_FEED_CACHE_MB", "0")   # block can never cache
+    over = run()
+    assert all(kind == "slice" for kind, *_ in log), log
+    assert len(log) == 4                            # one slice per batch
+
+    monkeypatch.setenv("RDT_FEED_CACHE_MB", "64")  # block caches on first use
+    under = run()
+    assert ("full-decode", "x") in log
+    assert sum(1 for kind, *_ in log if kind == "full-decode") == 1
+    np.testing.assert_array_equal(over, under)      # same rows either way
