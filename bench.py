@@ -515,6 +515,9 @@ def bench_gang() -> dict:
                "scaling": {str(w): round(v["samples_per_s"] / base, 3)
                            for w, v in sweep.items()},
                "collective_delta_ms_per_step": collective_delta_ms}
+        # checkpoint the completed sweep before the optional microbench: a
+        # microbench stall at the cap must not erase the measured sweep
+        print(RESULT_MARK + json.dumps(out), flush=True)
         # ... versus the INDEPENDENT measurement: the same gradient-leaf psum
         # pattern with zero model compute (benchmarks/
         # gang_collective_microbench.py), run fresh here at 1 and 2 ranks.
@@ -530,7 +533,8 @@ def bench_gang() -> dict:
                              "benchmarks", "gang_collective_microbench.py"))
             micro = _ilu.module_from_spec(spec)
             spec.loader.exec_module(micro)
-            ms1, ms2 = micro.measure(1), micro.measure(2)
+            ms1, ms2 = micro.measure(1, timeout=180), \
+                micro.measure(2, timeout=180)
             psum_delta = max(ms2 - ms1, 1e-6)
             out["psum_microbench_ms_per_step"] = {
                 "1": round(ms1, 1), "2": round(ms2, 1)}
@@ -685,6 +689,11 @@ def bench_transformer() -> dict:
     def _one(mode: str, fused: Optional[str] = None) -> dict:
         t_mode = SEQ_LEN
         transient_retries = 1
+        # OOM backoffs are recorded under the ENTRY's key, so a fused2 OOM
+        # can neither masquerade as a plain-flash backoff nor be swallowed
+        # by one (code-review r5)
+        oom_key = (f"{mode}_oom_at_seq_len" if fused is None
+                   else f"{mode}_fused{fused}_oom_at_seq_len")
         prev = os.environ.get("BENCH_LM_FUSED")
         if fused is not None:
             os.environ["BENCH_LM_FUSED"] = fused
@@ -701,7 +710,7 @@ def bench_transformer() -> dict:
                            or "out of memory" in msg.lower()
                            or "Ran out of memory" in msg)
                     if oom and t_mode > 1024:
-                        out.setdefault(f"{mode}_oom_at_seq_len", t_mode)
+                        out.setdefault(oom_key, t_mode)
                         t_mode //= 2
                         continue
                     if not oom and transient_retries > 0:
@@ -808,6 +817,14 @@ def _spawn_config(name: str, cap_s: float, platform: str) -> dict:
             return result
         return timeout_info
     if result is not None:
+        if proc.returncode:
+            # the child died AFTER a checkpoint marker (segfault/OOM-kill
+            # mid-mode): the salvaged entries are real but the run is NOT
+            # complete — tag it so the scheduler treats it like a failure
+            # (requeue/prior_attempt) instead of a clean result
+            result.update(partial=True,
+                          error=f"config subprocess died rc={proc.returncode} "
+                                "after a partial result")
         return result
     return {"error": f"config subprocess rc={proc.returncode}, "
                      "no result line"}

@@ -1,0 +1,72 @@
+"""Host-feed decode: native staging kernel vs the numpy astype+stack path.
+
+The streaming DeviceFeed's per-epoch host cost is dominated by this decode
+for over-cap datasets (VERDICT r4 #3 / SURVEY §7 step 2). Shapes mirror the
+bench workloads: NYCTaxi (25 f64 cols -> f32) and Criteo DLRM dense+cats
+(13 f64 -> f32 + 26 i64 -> i32).
+
+Run: python benchmarks/host_decode_bench.py [rows]
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from raydp_tpu.native.stage import native_stage_available, stage_table  # noqa: E402
+
+
+def numpy_path(table, columns, dtype):
+    return np.stack(
+        [table.column(c).to_numpy(zero_copy_only=False).astype(dtype,
+                                                               copy=False)
+         for c in columns], axis=1)
+
+
+def bench(name, table, columns, dtype, reps=5):
+    # warm + correctness
+    a = numpy_path(table, columns, dtype)
+    b = stage_table(table, columns, np.dtype(dtype))
+    assert b is not None, "kernel declined an eligible table"
+    np.testing.assert_array_equal(a, b)
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        numpy_path(table, columns, dtype)
+    t_np = (time.perf_counter() - t0) / reps
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        stage_table(table, columns, np.dtype(dtype))
+    t_nat = (time.perf_counter() - t0) / reps
+
+    rows = table.num_rows
+    print(f"{name}: rows={rows} cols={len(columns)} "
+          f"numpy={t_np * 1e3:.1f}ms native={t_nat * 1e3:.1f}ms "
+          f"speedup={t_np / t_nat:.2f}x "
+          f"({rows / t_nat / 1e6:.1f}M rows/s native)")
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 400_000
+    if not native_stage_available():
+        raise SystemExit("native staging kernel unavailable")
+    rng = np.random.RandomState(0)
+
+    nyctaxi = pa.table({f"f{i}": rng.randn(rows) for i in range(25)})
+    bench("nyctaxi-features f64->f32", nyctaxi,
+          [f"f{i}" for i in range(25)], np.float32)
+
+    dense = pa.table({f"d{i}": rng.randn(rows) for i in range(13)})
+    bench("dlrm-dense f64->f32", dense, [f"d{i}" for i in range(13)],
+          np.float32)
+
+    cats = pa.table({f"c{i}": rng.randint(0, 1 << 20, rows)
+                     for i in range(26)})
+    bench("dlrm-cats i64->i32", cats, [f"c{i}" for i in range(26)], np.int32)
+
+
+if __name__ == "__main__":
+    main()

@@ -61,7 +61,19 @@ def _normalize_columns(columns: Dict[str, Tuple[ColumnSpec, np.dtype]]
 
 
 def _as_numpy(table: pa.Table, columns: Sequence[str], dtype) -> np.ndarray:
-    """Stack columns into [rows, len(columns)] (or [rows] for one column)."""
+    """Stack columns into [rows, len(columns)] (or [rows] for one column).
+
+    Multi-column decode goes through the native staging kernel when eligible
+    (csrc/feed/stage.cpp: cast+interleave fused into one pass per column,
+    straight from the Arrow data buffers — SURVEY.md §7 step 2's "Arrow ↔
+    host buffer staging"); null-bearing/non-primitive columns and missing
+    toolchains fall back to the numpy path below, output-identical
+    (tests/test_native_stage.py)."""
+    if len(columns) > 1:
+        from raydp_tpu.native.stage import stage_table
+        staged = stage_table(table, columns, dtype)
+        if staged is not None:
+            return staged
     arrays = []
     for c in columns:
         col = table.column(c)

@@ -834,6 +834,18 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                 "model.fit semantics")
         ckpt_dir = self.checkpoint_dir or tempfile.mkdtemp(
             prefix="rdt-keras-gang-")
+        if self.checkpoint_dir and (
+                os.path.exists(os.path.join(ckpt_dir, "model.keras"))
+                or os.path.exists(os.path.join(ckpt_dir, "state.json"))):
+            # gang ranks run with resume=True by design, so a fresh fit_gang
+            # pointed at a reused dir silently ADOPTS the earlier run's
+            # checkpoint — warn before the ranks start (the flax twin's
+            # warn_if_reused_dir, for the keras model.keras/state.json format)
+            logger.warning(
+                "checkpoint_dir %r already holds a model.keras/state.json "
+                "from an earlier run; this gang will RESUME from it — use a "
+                "fresh checkpoint_dir per run to train from scratch",
+                ckpt_dir)
         train_payload = train_ds.portable()
         eval_payload = (evaluate_ds.portable()
                         if evaluate_ds is not None else None)

@@ -268,3 +268,17 @@ def test_partial_tpu_results_survive_fallback_rerun(bench, monkeypatch,
     entry = out["extra"]["transformer"]
     assert entry["dense"] == {"mfu": 0.2}
     assert entry["prior_attempt"]["flash"] == {"mfu": 0.59}
+
+
+def test_spawn_config_crashed_child_after_marker_tagged_partial(bench,
+                                                                monkeypatch):
+    """A child that dies AFTER printing a checkpoint marker must not read as
+    a clean result — incremental checkpoints broke the old any-marker=success
+    invariant, so the non-timeout path checks returncode."""
+    lines = bench.RESULT_MARK + json.dumps({"flash": {"mfu": 0.59}}) + "\n"
+    proc = _FakeProc(lines)
+    proc.returncode = 137
+    monkeypatch.setattr(bench.subprocess, "Popen", lambda *a, **k: proc)
+    out = bench._spawn_config("transformer", 60.0, "default")
+    assert out["flash"] == {"mfu": 0.59}
+    assert out["partial"] is True and "died rc=137" in out["error"]
