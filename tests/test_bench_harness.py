@@ -19,15 +19,27 @@ def bench(monkeypatch, tmp_path):
             os.path.abspath(__file__))), "bench.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    # keep the compile-cache setup away from the repo during tests
+    # keep the compile-cache setup and the detail record away from the repo
     monkeypatch.setenv("RDT_JAX_CACHE_DIR", str(tmp_path / "jc"))
+    monkeypatch.setenv("RDT_BENCH_DETAIL_PATH",
+                       str(tmp_path / "BENCH_DETAIL.json"))
     return mod
 
 
 def _run_main(bench, capsys):
+    """Run main() and return the RICH record (BENCH_DETAIL.json). stdout's
+    final line is a compact digest sized for the driver's 2000-char tail;
+    the detail file carries the full per-config results — consistency of the
+    two is asserted here so every test exercises both."""
     bench.main()
-    out = capsys.readouterr().out.strip().splitlines()[-1]
-    return json.loads(out)
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    compact = json.loads(line)
+    assert len(line) <= 1900, f"stdout line too big for the driver: {len(line)}"
+    with open(os.environ["RDT_BENCH_DETAIL_PATH"]) as fh:
+        detail = json.load(fh)
+    for key in ("metric", "unit", "platform", "value", "vs_baseline"):
+        assert compact.get(key) == detail.get(key), key
+    return detail
 
 
 def test_mid_matrix_wedge_falls_back_to_cpu(bench, monkeypatch, capsys):
@@ -282,3 +294,46 @@ def test_spawn_config_crashed_child_after_marker_tagged_partial(bench,
     out = bench._spawn_config("transformer", 60.0, "default")
     assert out["flash"] == {"mfu": 0.59}
     assert out["partial"] is True and "died rc=137" in out["error"]
+
+
+def test_stdout_line_fits_driver_tail_and_detail_file_is_full(bench,
+                                                              monkeypatch,
+                                                              capsys,
+                                                              tmp_path):
+    """The driver stores only the last 2000 chars of stdout and parses the
+    final line out of THAT (r04's rich line was head-truncated and recorded
+    as parsed:None). The stdout line must stay compact no matter how big the
+    per-config results get; the full record goes to BENCH_DETAIL.json."""
+    monkeypatch.setenv("BENCH_CONFIGS", "nyctaxi,transformer,gang")
+
+    big = {"sweep": {str(w): {"samples_per_s": w * 1000.0,
+                              "note": "x" * 400} for w in (1, 2, 4)},
+           "scaling": {"1": 1.0, "2": 0.6, "4": 0.4},
+           "collective_mechanism_ratio": 1.2}
+
+    def fake_spawn(name, cap_s, platform):
+        if name == "nyctaxi":
+            return {"samples_per_s_per_chip": 1000.0, "pad": "y" * 800}
+        if name == "transformer":
+            return {"flash": {"tokens_per_s": 83000.0, "mfu": 0.59,
+                              "seq_len": 8192, "pad": "z" * 800},
+                    "dense": {"tokens_per_s": 1000.0, "seq_len": 4096},
+                    "flash_fused2": {"tokens_per_s": 80000.0, "mfu": 0.57,
+                                     "seq_len": 8192}}
+        return dict(big)
+
+    monkeypatch.setattr(bench, "_spawn_config", fake_spawn)
+    monkeypatch.setattr(bench, "_probe_devices", lambda timeout_s=None: "tpu")
+
+    bench.main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert len(line) <= 1900, len(line)
+    out = json.loads(line)
+    assert out["value"] == 1000.0 and out["metric"]
+    assert out["extra"]["transformer"]["flash"]["mfu"] == 0.59
+    assert out["extra"]["transformer"]["flash_fused2"]["tok_s"] == 80000.0
+    assert out["extra"]["gang"]["mechanism_ratio"] == 1.2
+
+    detail = json.loads((tmp_path / "BENCH_DETAIL.json").read_text())
+    assert detail["extra"]["nyctaxi"]["pad"] == "y" * 800   # nothing lost
+    assert detail["value"] == 1000.0

@@ -1,0 +1,135 @@
+"""README perf claims ↔ recorded artifacts consistency (VERDICT r4 #8).
+
+The r2–r4 failure mode: README's "Measured performance" table carried
+numbers (417k samples/s, 59.6% MFU, ...) that existed in NO recorded
+artifact — claims and record drifted apart for three rounds. The contract
+enforced here:
+
+- ``PERF_CLAIMS.json`` maps every README perf number to a dotted path inside
+  a recorded artifact in the tree (driver ``BENCH_r*.json`` — the bench line
+  lives in their ``parsed``/``tail`` fields — or the bench-written
+  ``BENCH_DETAIL.json``), with a tolerance.
+- Every claim's artifact value must match the claimed value.
+- Every claim's exact README string must appear in README.md.
+- Every perf-looking number inside README's "Measured performance" section
+  must be covered by some claim string — adding an unbacked number to the
+  table fails this test.
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_artifact(name):
+    path = os.path.join(ROOT, name)
+    with open(path) as fh:
+        data = json.load(fh)
+    if "metric" in data:
+        return data                      # a bare bench record
+    if isinstance(data.get("parsed"), dict):
+        return data["parsed"]            # driver wrapper, parsed line
+    for line in reversed(data.get("tail", "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                j = json.loads(line)
+                if "metric" in j:
+                    return j
+            except ValueError:
+                continue
+    raise AssertionError(f"{name}: no bench record found")
+
+
+def _resolve(record, dotted):
+    cur = record
+    for part in dotted.split("."):
+        if isinstance(cur, list):
+            cur = cur[int(part)]
+        else:
+            assert isinstance(cur, dict) and part in cur, \
+                f"path {dotted!r}: {part!r} missing"
+            cur = cur[part]
+    return cur
+
+
+def _claims():
+    path = os.path.join(ROOT, "PERF_CLAIMS.json")
+    if not os.path.exists(path):
+        pytest.skip("PERF_CLAIMS.json not present")
+    with open(path) as fh:
+        return json.load(fh)["claims"]
+
+
+def _readme_perf_section():
+    with open(os.path.join(ROOT, "README.md")) as fh:
+        text = fh.read()
+    m = re.search(r"## Measured performance.*?(?=\n## )", text, re.S)
+    assert m, "README lost its Measured performance section"
+    return text, m.group(0)
+
+
+def _artifact_value(claim):
+    if "regex" in claim:   # text artifacts (e.g. BASELINE.md tables)
+        with open(os.path.join(ROOT, claim["artifact"])) as fh:
+            m = re.search(claim["regex"], fh.read(), re.S)
+        assert m, f"{claim['id']}: regex found nothing in {claim['artifact']}"
+        return float(m.group(1).replace(",", "").replace("_", ""))
+    return _resolve(_load_artifact(claim["artifact"]), claim["path"])
+
+
+def _display_number(readme):
+    """First number in the claim's README string, k/M-scaled."""
+    m = re.search(r"([0-9][\d,]*(?:\.\d+)?)\s*(k|M)?", readme)
+    num = float(m.group(1).replace(",", ""))
+    return num * {None: 1.0, "k": 1e3, "M": 1e6}[m.group(2)]
+
+
+def test_claims_match_artifacts():
+    for claim in _claims():
+        actual = _artifact_value(claim)
+        expect = claim["value"]
+        tol = claim.get("tol", 0.02)
+        assert actual == pytest.approx(expect, rel=tol), \
+            f"{claim['id']}: artifact {claim['artifact']} = {actual}, " \
+            f"claim says {expect}"
+        # and the HUMAN-VISIBLE number must round to the artifact value too
+        # (a claim displaying 417k against a 133k artifact value would
+        # otherwise pass on a sloppy 'value' field)
+        shown = _display_number(claim["readme"])
+        factor = claim.get("display_factor", 1.0)
+        assert shown == pytest.approx(expect * factor, rel=0.05), \
+            f"{claim['id']}: README shows {shown}, artifact holds " \
+            f"{expect * factor}"
+
+
+def test_readme_contains_every_claim_string():
+    text, _ = _readme_perf_section()
+    for claim in _claims():
+        assert claim["readme"] in text, \
+            f"{claim['id']}: README no longer contains {claim['readme']!r}"
+
+
+def test_readme_perf_numbers_are_all_backed():
+    """Every perf-shaped number in the Measured performance section must be
+    part of some claim's README string (so new numbers need new claims)."""
+    claims = _claims()
+    _, section = _readme_perf_section()
+    covered = [c["readme"] for c in claims]
+    pattern = re.compile(
+        r"[0-9][\d,.]*\s*(?:k|M)?\s*"
+        # bare × only counts as a perf multiple when NOT a dimension product
+        # ("18.2× torch-CPU" yes; "dim 512 × 4 layers" no); hyphenated and
+        # of-peak percent spellings count too ("60%-MFU", "51% of peak")
+        r"(?:samples/s(?:/chip)?|tok/s|tokens/s|TFLOP/s|%[ -]MFU|% of peak"
+        r"|×(?!\s*\d)|ms\b)",
+    )
+    for match in pattern.finditer(section):
+        token = match.group(0)
+        assert any(token in c for c in covered), \
+            f"README perf number {token!r} is not backed by any claim in " \
+            "PERF_CLAIMS.json"
