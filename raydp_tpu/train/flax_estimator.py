@@ -62,6 +62,19 @@ def _takes_train(model) -> bool:
         return False
 
 
+def _cast_floating(inputs, dtype):
+    """Cast the floating leaves of a batch pytree to the compute dtype —
+    THE cast policy, shared by the train loop and predict."""
+    if dtype is None:
+        return inputs
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, inputs)
+
+
 def _resolve_loss(loss) -> Callable:
     import jax.numpy as jnp
 
@@ -302,16 +315,9 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         compute_dtype = self.compute_dtype
         split_batch = self._split_batch
 
-        def _cast_inputs(inputs):
-            if compute_dtype is None:
-                return inputs
-            return jax.tree.map(
-                lambda a: a.astype(compute_dtype)
-                if jnp.issubdtype(a.dtype, jnp.floating) else a, inputs)
-
         def _apply(params, bstats, batch, train: bool):
             inputs, labels = split_batch(batch)
-            inputs = _cast_inputs(inputs)
+            inputs = _cast_floating(inputs, compute_dtype)
             variables = {"params": params}
             kwargs = {"train": train} if takes_train else {}
             if bstats is not None:
@@ -772,45 +778,63 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
     # ---------------------------------------------------------------- predict
     def predict(self, ds, batch_size: Optional[int] = None) -> np.ndarray:
-        """Run the trained model over a dataset's feature columns and return
-        predictions as one host array (row order = dataset block order).
+        """Run the trained model over a dataset and return predictions as
+        one host array (row order = dataset block order).
 
         Convenience beyond the reference (whose users rebuild an inference
-        loop around ``get_model``); models with a custom
-        ``batch_preprocessor`` consuming labels are not supported here —
-        apply ``get_model`` manually for those.
+        loop around ``get_model``). Works for plain ``feature_columns``
+        models AND for ``batch_preprocessor`` / ``columns_spec`` models
+        (e.g. DLRM): those decode the same column spec the train feed used
+        and run the preprocessor in-jit per batch, exactly like the train
+        step. A ``label`` spec entry whose column(s) the dataset lacks (the
+        normal inference frame) is synthesized as zeros — the preprocessor's
+        label output is discarded anyway.
         """
         import jax
         import jax.numpy as jnp
 
         from raydp_tpu.data.feed import HostBatchIterator
 
-        if self.batch_preprocessor is not None or self.columns_spec is not None:
-            raise NotImplementedError(
-                "predict() supports the feature_columns path; apply "
-                "get_model() manually for batch_preprocessor / columns_spec "
-                "models")
         model = self._build_model()
         variables = self.get_model()   # raises if fit() has not run
         kwargs = {"train": False} if _takes_train(model) else {}
 
         compute_dtype = self.compute_dtype
+        custom = (self.batch_preprocessor is not None
+                  or self.columns_spec is not None)
+        split_batch = self._split_batch
 
         @jax.jit
-        def infer(inputs):
-            if compute_dtype is not None and jnp.issubdtype(
-                    inputs.dtype, jnp.floating):
-                inputs = inputs.astype(compute_dtype)
+        def infer(jbatch):
+            # preprocessor + cast run INSIDE jit, like the train step's
+            # _apply — one dispatch per batch, no eager slicing/casting
+            inputs = split_batch(jbatch)[0] if custom \
+                else jbatch["features"]
+            inputs = _cast_floating(inputs, compute_dtype)
             preds = model.apply(variables, inputs, **kwargs)
             if preds.ndim >= 2 and preds.shape[-1] == 1:
                 preds = preds.squeeze(-1)
             return preds.astype(jnp.float32)
 
-        cols = {"features": (self.feature_columns, self.feature_dtype)}
+        cols = dict(self._columns()) if custom else {
+            "features": (self.feature_columns, self.feature_dtype)}
+        synth_label = None
+        if custom and "label" in cols:
+            lcols, ldt = cols["label"]
+            lnames = (lcols,) if isinstance(lcols, str) else tuple(lcols)
+            have = set(ds.schema.names)
+            if not all(c in have for c in lnames):
+                cols.pop("label")
+                synth_label = np.dtype(ldt)
         it = HostBatchIterator(ds, batch_size or self.batch_size, cols,
                                shuffle=False, drop_remainder=False)
-        out = [np.asarray(infer(jnp.asarray(batch["features"])))
-               for batch in it]
+        out = []
+        for batch in it:
+            if synth_label is not None:
+                rows = len(next(iter(batch.values())))
+                batch["label"] = np.zeros((rows,), synth_label)
+            out.append(np.asarray(infer(
+                {k: jnp.asarray(v) for k, v in batch.items()})))
         if not out:
             return np.empty((0,), np.float32)
         return np.concatenate(out, axis=0)

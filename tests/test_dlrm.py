@@ -68,3 +68,30 @@ def test_dlrm_fit_sharded_embeddings(session):
     emb = result.state.params["embedding_0"]["embedding"]
     shard_rows = emb.sharding.shard_shape(emb.shape)[0]
     assert shard_rows == emb.shape[0] // 4
+
+    # predict() works for batch_preprocessor models: the same column spec
+    # decodes, the preprocessor splits, the label is read and discarded —
+    # and the output matches a manual get_model() apply on the first rows
+    from raydp_tpu.data import from_frame
+
+    ds = from_frame(df)
+    preds = est.predict(ds, batch_size=128)
+    assert preds.shape == (2048,) and preds.dtype == np.float32
+
+    # the normal inference frame has NO label column: predict synthesizes
+    # the spec's label entry as zeros (discarded) and returns the same preds
+    ds_nolabel = from_frame(df.drop("_c0"))
+    np.testing.assert_array_equal(est.predict(ds_nolabel, batch_size=128),
+                                  preds)
+
+    import jax.numpy as jnp
+    table = ds.get_block(0)
+    feats = np.stack([table.column(c).to_numpy(zero_copy_only=False)
+                      .astype(np.float64) for c in features], axis=1)
+    inputs, _ = est.batch_preprocessor(
+        {"features": jnp.asarray(feats),
+         "label": jnp.zeros((len(feats),), jnp.float32)})
+    manual = est._build_model().apply(est.get_model(), inputs)
+    np.testing.assert_allclose(preds[:len(feats)],
+                               np.asarray(manual).squeeze(-1),
+                               rtol=2e-4, atol=2e-5)
