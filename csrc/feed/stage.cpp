@@ -21,8 +21,10 @@
 // (ObjectStoreReader.java + torch dataset collate); this is its TPU-native
 // replacement on the host side of the feed.
 
+#include <atomic>
 #include <cstdint>
 #include <thread>
+#include <type_traits>
 #include <vector>
 
 namespace {
@@ -46,6 +48,13 @@ void cast_into(const void* src_v, void* dst_v, int64_t rows,
 template <typename D>
 int dispatch_src(const void* src, int src_type, void* dst, int64_t rows,
                  int64_t dst_stride, int64_t dst_col) {
+  // float -> integral is undefined behavior in C++ for NaN/out-of-range
+  // values (and numpy's fallback has different, platform-defined behavior,
+  // so the byte-parity contract cannot hold either way): decline the pair,
+  // the Python caller falls back to numpy.
+  if (std::is_integral<D>::value && (src_type == F32 || src_type == F64)) {
+    return -1;
+  }
   switch (src_type) {
     case F32: cast_into<float, D>(src, dst, rows, dst_stride, dst_col); return 0;
     case F64: cast_into<double, D>(src, dst, rows, dst_stride, dst_col); return 0;
@@ -97,8 +106,13 @@ int rdt_stage_columns(const void** srcs, const int* src_types, int64_t n_cols,
                       int64_t rows, void* dst, int dst_type, int n_threads) {
   if (n_cols <= 0) return -1;
   // validate dtypes up-front so threaded work cannot partially fail
+  bool dst_integral = (dst_type == I32 || dst_type == I64);
   for (int64_t c = 0; c < n_cols; ++c) {
     if (src_types[c] < F32 || src_types[c] > U64) return -1;
+    // float -> int: UB on NaN/out-of-range, declined (see dispatch_src)
+    if (dst_integral && (src_types[c] == F32 || src_types[c] == F64)) {
+      return -1;
+    }
   }
   if (dst_type != F32 && dst_type != F64 && dst_type != I32 &&
       dst_type != I64) {
@@ -113,17 +127,25 @@ int rdt_stage_columns(const void** srcs, const int* src_types, int64_t n_cols,
     return 0;
   }
   int workers = n_threads < n_cols ? n_threads : static_cast<int>(n_cols);
+  // per-worker status accumulates into one atomic flag: the pre-checks above
+  // should make a dispatch miss unreachable, but a future edit loosening
+  // them (or a Python/C++ dtype-table drift) must fail loudly with -1, never
+  // silently leave np.empty garbage in unwritten columns (ADVICE r5 #3)
+  std::atomic<int> status{0};
   std::vector<std::thread> pool;
   pool.reserve(workers);
   for (int w = 0; w < workers; ++w) {
-    pool.emplace_back([=]() {
+    pool.emplace_back([=, &status]() {
       for (int64_t c = w; c < n_cols; c += workers) {
-        stage_one(srcs[c], src_types[c], rows, dst, dst_type, n_cols, c);
+        if (stage_one(srcs[c], src_types[c], rows, dst, dst_type, n_cols,
+                      c)) {
+          status.store(-1, std::memory_order_relaxed);
+        }
       }
     });
   }
   for (auto& t : pool) t.join();
-  return 0;
+  return status.load(std::memory_order_relaxed);
 }
 
 }  // extern "C"

@@ -8,8 +8,16 @@ Design for the hardware: batches are assembled host-side as contiguous numpy
 ``jax.device_put`` under a ``NamedSharding`` over the mesh's data axis, so the
 train step's inputs are already distributed and XLA inserts no gather. Shapes are
 static (``drop_remainder``) — a changing batch dimension would retrace/recompile
-under jit. A background thread keeps ``prefetch`` host batches ahead so input
-assembly overlaps device compute.
+under jit.
+
+The streaming pipeline is ASYNC and DOUBLE-BUFFERED (:class:`DevicePrefetcher`):
+a host stage keeps ``prefetch`` decoded batches ahead, and a device stage keeps
+``prefetch_to_device`` already-``device_put`` batches ahead, so the H2D transfer
+(and the chained path's stack assembly) for batch ``k+1`` overlaps the jitted
+compute of batch ``k``. The reference prefetches only *host* batches; pipelining
+the device side is what removes ``device_put`` from the step critical path.
+Per-phase walls (``decode``/``stage``/``h2d``) accumulate in
+:class:`PipelineTimings` and surface in the estimators' epoch reports.
 
 Multi-host: each process feeds its own shard and the global array is built with
 ``jax.make_array_from_process_local_data`` — the per-host ``device_put`` endpoint
@@ -21,6 +29,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -500,8 +509,181 @@ class DeviceEpochCache:
             return False
 
 
+class PipelineTimings:
+    """Thread-safe per-phase wall accumulator for the feed pipeline.
+
+    Phases (surfaced per epoch as ``decode_time_s``/``stage_time_s``/
+    ``h2d_time_s`` by both estimators, aggregated into bench.py's detail
+    record):
+
+    - ``decode`` — host batch production: Arrow→numpy decode (native staging
+      kernel included) plus the host iterator's own batch assembly.
+    - ``stage``  — dispatch-stack assembly (the chained path's ``np.stack``).
+    - ``h2d``    — device placement: ``jax.device_put`` /
+      ``make_array_from_process_local_data`` under the feed's sharding.
+
+    The timers run on the pipeline's background threads, so phase walls
+    OVERLAP the consumer's dispatch wall by design — pipeline wall-clock
+    under the sum of phase walls is the overlap win, measured directly by
+    ``benchmarks/host_decode_bench.py --overlap``.
+    """
+
+    KEYS = ("decode", "stage", "h2d")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._acc = {k: 0.0 for k in self.KEYS}
+
+    def add(self, key: str, dt: float) -> None:
+        with self._lock:
+            self._acc[key] += dt
+
+    def take(self) -> Dict[str, float]:
+        """Snapshot AND reset — each epoch reports its own split."""
+        with self._lock:
+            out = dict(self._acc)
+            for k in self._acc:
+                self._acc[k] = 0.0
+        return out
+
+
+class DevicePrefetcher:
+    """Bounded async stage of the device-feed pipeline (double buffering).
+
+    Pulls items from ``src`` on a background thread, applies ``fn`` (the
+    device stage passes ``jax.device_put`` under the feed's sharding), and
+    keeps up to ``depth`` results queued ahead of the consumer, so staging +
+    H2D for batch ``k+1`` overlap the jitted compute of batch ``k``. The
+    bounded queue IS the backpressure: the producer can run at most
+    ``depth + 1`` items ahead. Producer exceptions re-raise in the consumer;
+    closing (or abandoning) the iterator stops the thread — an estimator
+    error cannot leak one producer per epoch. Single-use: one ``iter()`` per
+    instance.
+
+    ``pull_key``/``work_key`` name the :class:`PipelineTimings` phases the
+    ``next(src)`` pull and the ``fn`` call accumulate into (the host stage
+    times its pulls as ``decode``; the device stage's placement is timed by
+    the feed so the sync path measures identically).
+    """
+
+    _DONE = object()
+
+    def __init__(self, src, fn=None, depth: int = 2, timings=None,
+                 pull_key: Optional[str] = None,
+                 work_key: Optional[str] = None,
+                 name: str = "devicefeed-prefetch"):
+        self._src = src
+        self._fn = fn
+        self._timings = timings
+        self._pull_key = pull_key
+        self._work_key = work_key
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=name)
+        self._started = False
+
+    def _run(self):
+        try:
+            src = iter(self._src)
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    item = next(src)
+                except StopIteration:
+                    break
+                if self._timings is not None and self._pull_key:
+                    self._timings.add(self._pull_key,
+                                      time.perf_counter() - t0)
+                if self._fn is not None:
+                    t1 = time.perf_counter()
+                    item = self._fn(item)
+                    if self._timings is not None and self._work_key:
+                        self._timings.add(self._work_key,
+                                          time.perf_counter() - t1)
+                if not self._put(item):
+                    break
+            self._put(self._DONE)  # no-op if stopped
+        except BaseException as e:  # noqa: BLE001 - re-raised by the consumer
+            self._put(e)
+        finally:
+            if self._stop.is_set():
+                # stopped early: close() may already have run (and given up
+                # after its join timeout if THIS thread was mid-fn), so the
+                # upstream close falls to us — otherwise a chained host
+                # stage would keep decoding into its full queue forever
+                self._close_src()
+
+    def _put(self, item) -> bool:
+        """Blocking put that stays responsive to :meth:`close` (the timeout
+        only ticks while the queue is FULL, i.e. the pipeline is ahead)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _close_src(self) -> None:
+        """Best-effort upstream cleanup: a generator src (e.g. the chained
+        host stage's output) closes its own stage in its finally. Both the
+        consumer's close() and the producer's finally may race here —
+        generator.close() raises on the loser, swallowed below."""
+        src_close = getattr(self._src, "close", None)
+        if src_close is not None:
+            try:
+                src_close()
+            except Exception:  # noqa: BLE001 - already shutting down
+                pass
+
+    def __iter__(self):
+        if self._started:
+            raise RuntimeError("DevicePrefetcher is single-use")
+        self._started = True
+        self._thread.start()
+        try:
+            while True:
+                item = self._q.get()
+                if item is self._DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            self.close()
+
+    def _drain(self) -> None:
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def close(self) -> None:
+        """Stop the producer and release queued buffers (idempotent)."""
+        self._stop.set()
+        self._drain()  # unblocks a producer waiting on a full queue
+        if self._started and self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        self._drain()  # a mid-put producer may have landed one more item
+        if not self._thread.is_alive():
+            # thread gone (or never started): upstream close is on us; a
+            # still-running thread (join timeout: mid-fn on a slow
+            # device_put) closes upstream itself in _run's finally
+            self._close_src()
+
+
 class DeviceFeed:
-    """Prefetching iterator of device-sharded batches over a mesh data axis."""
+    """Async double-buffered iterator of device-sharded batches.
+
+    Two background stages feed the consumer: host decode (``prefetch``
+    decoded batches ahead — the reference ``PrefetchedDataLoader``'s trick)
+    and device placement (``prefetch_to_device`` already-placed batches
+    ahead, so H2D for batch ``k+1`` overlaps the compute of batch ``k``;
+    ``0`` restores synchronous placement — bit-identical results either way,
+    tests/test_feed_pipeline.py). ``timings`` carries the per-phase
+    decode/stage/h2d split the estimators report per epoch."""
 
     def __init__(
         self,
@@ -516,6 +698,7 @@ class DeviceFeed:
         prefetch: int = 2,
         drop_remainder: bool = True,
         host_iter=None,
+        prefetch_to_device: Optional[int] = None,
     ):
         import jax
         self._jax = jax
@@ -525,6 +708,13 @@ class DeviceFeed:
             dataset, batch_size, columns, shard=shard, shuffle=shuffle,
             seed=seed, drop_remainder=drop_remainder)
         self.prefetch = max(1, prefetch)
+        if prefetch_to_device is None:
+            prefetch_to_device = int(
+                os.environ.get("RDT_PREFETCH_TO_DEVICE", "2"))
+        #: already-placed batches kept ahead of the consumer (0 = place
+        #: synchronously on the consumer thread)
+        self.prefetch_to_device = max(0, int(prefetch_to_device))
+        self.timings = PipelineTimings()
         self._shardings = None
         if mesh is not None:
             if data_axis is None:
@@ -560,40 +750,34 @@ class DeviceFeed:
         return {n: jax.device_put(a, sharding) for n, a in batch.items()}
 
     def _host_batches(self):
-        """Host batches through the background prefetch thread."""
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
-        stop = threading.Event()
-        SENTINEL = object()
+        """Host batches decoded ``prefetch`` ahead on a background thread;
+        the pull wall (Arrow→numpy decode, native staging kernel included)
+        accumulates as the ``decode`` phase."""
+        return iter(DevicePrefetcher(
+            self.host_iter, depth=self.prefetch, timings=self.timings,
+            pull_key="decode", name="devicefeed-host"))
 
-        def _producer():
-            try:
-                for batch in self.host_iter:
-                    if stop.is_set():
-                        return
-                    q.put(batch)
-            except BaseException as e:  # propagate into consumer
-                q.put(e)
-                return
-            finally:
-                q.put(SENTINEL)
+    def _timed_place(self, batch, sharding=None):
+        t0 = time.perf_counter()
+        out = self._place(batch, sharding=sharding)
+        self.timings.add("h2d", time.perf_counter() - t0)
+        return out
 
-        t = threading.Thread(target=_producer, daemon=True,
-                             name="devicefeed-prefetch")
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is SENTINEL:
-                    return
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-        finally:
-            stop.set()
+    def _placed(self, items, place_fn):
+        """Run ``place_fn`` over ``items`` — through the async
+        :class:`DevicePrefetcher` stage when ``prefetch_to_device`` > 0,
+        inline otherwise. Same values in the same order either way; the
+        async stage only moves the work off the consumer's critical path."""
+        if self.prefetch_to_device <= 0:
+            for item in items:
+                yield place_fn(item)
+            return
+        yield from DevicePrefetcher(
+            items, fn=place_fn, depth=self.prefetch_to_device,
+            name="devicefeed-device")
 
     def __iter__(self):
-        for batch in self._host_batches():
-            yield self._place(batch)
+        yield from self._placed(self._host_batches(), self._timed_place)
 
     def chained(self, k: int):
         """Yield ``(placed_stack, n)``: up to ``k`` host batches stacked on a
@@ -602,7 +786,11 @@ class DeviceFeed:
         dispatch+fetch costs a full round trip (~64 ms measured), so chaining
         k steps divides that overhead by k. The scan dim is unsharded; the
         batch dim keeps the feed's data sharding. A smaller final stack (the
-        epoch remainder) compiles once more and is otherwise fine."""
+        epoch remainder) compiles once more and is otherwise fine.
+
+        With ``prefetch_to_device`` > 0 the stack assembly (the ``stage``
+        phase) AND the placement run on the device-prefetch thread, so both
+        overlap the consumer's dispatched compute."""
         if k <= 1:
             for batch in self:
                 yield batch, 1
@@ -613,24 +801,33 @@ class DeviceFeed:
             stacked_sharding = NamedSharding(
                 self.mesh, PartitionSpec(None, *tuple(self._sharding.spec)))
 
-        def _flush(buf):
-            stacked = {n: np.stack([b[n] for b in buf]) for n in buf[0]}
-            return self._place(stacked, sharding=stacked_sharding), len(buf)
-
         def _rows(b: Dict[str, np.ndarray]) -> int:
             return next(iter(b.values())).shape[0]
 
-        buf: List[Dict[str, np.ndarray]] = []
-        for batch in self._host_batches():
-            if buf and _rows(batch) != _rows(buf[0]):
-                # ragged batch (the drop_remainder=False epoch tail): it
-                # cannot stack with full batches — flush what we have, then
-                # let it travel alone
-                yield _flush(buf)
-                buf = []
-            buf.append(batch)
-            if len(buf) == k:
-                yield _flush(buf)
-                buf = []
-        if buf:
-            yield _flush(buf)
+        def _stack(buf):
+            t0 = time.perf_counter()
+            stacked = {n: np.stack([b[n] for b in buf]) for n in buf[0]}
+            self.timings.add("stage", time.perf_counter() - t0)
+            return stacked, len(buf)
+
+        def _stacks():
+            buf: List[Dict[str, np.ndarray]] = []
+            for batch in self._host_batches():
+                if buf and _rows(batch) != _rows(buf[0]):
+                    # ragged batch (the drop_remainder=False epoch tail): it
+                    # cannot stack with full batches — flush what we have,
+                    # then let it travel alone
+                    yield _stack(buf)
+                    buf = []
+                buf.append(batch)
+                if len(buf) == k:
+                    yield _stack(buf)
+                    buf = []
+            if buf:
+                yield _stack(buf)
+
+        def _place_stack(item):
+            stacked, n = item
+            return self._timed_place(stacked, sharding=stacked_sharding), n
+
+        yield from self._placed(_stacks(), _place_stack)

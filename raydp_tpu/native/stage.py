@@ -8,6 +8,13 @@ whenever a column is ineligible (nulls, non-primitive, unsupported dtype) or
 the toolchain is absent — behavior is identical either way, pinned by
 tests/test_native_stage.py parity tests.
 
+Float→int dtype pairs are DECLINED (here and in the kernel's own dispatch):
+``static_cast`` from a float to an integer is undefined behavior in C++ for
+NaN/out-of-range values, while numpy's astype has different,
+platform-defined behavior — the byte-parity contract only holds for
+float→float and (unsigned/signed) int→int pairs, so anything else falls
+back to numpy (ADVICE r5 #2).
+
 Threads: ``RDT_STAGE_THREADS`` fans columns out over a small pool (default 1:
 the CI host exposes one schedulable core, and the feed already overlaps
 device compute via the DeviceFeed prefetch thread).
@@ -141,6 +148,7 @@ def stage_table(table: pa.Table, columns: Sequence[str],
     # scan EVERY chunk for eligibility before allocating or casting anything:
     # discovering an ineligible chunk mid-decode would waste the whole pass
     # (numpy would then redo it) on every batch of a streaming feed
+    dst_integral = dst_code in (4, 5)   # I32 / I64
     plans: List[List] = []   # per column: [(ptr, code, n_rows), ...]
     single_chunk = True
     for name in columns:
@@ -152,8 +160,10 @@ def stage_table(table: pa.Table, columns: Sequence[str],
             ptr = _chunk_ptr(chunk)
             if ptr is None:
                 return None
-            chunks.append((ptr, _DTYPE_CODES[_ARROW_NUMERIC[chunk.type]],
-                           len(chunk)))
+            code = _DTYPE_CODES[_ARROW_NUMERIC[chunk.type]]
+            if dst_integral and code in (0, 1):   # float source → int dst:
+                return None                       # UB, declined (see module doc)
+            chunks.append((ptr, code, len(chunk)))
         single_chunk = single_chunk and len(chunks) == 1
         plans.append(chunks)
 

@@ -25,7 +25,7 @@ import os
 import tempfile
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -140,6 +140,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         callbacks: Optional[Sequence[Callable[[Dict], None]]] = None,
         steps_per_dispatch: int = 1,
         checkpoint_interval: int = 1,
+        prefetch_to_device: Optional[int] = None,
     ):
         if model is None and model_creator is None:
             raise ValueError("pass model or model_creator")
@@ -177,6 +178,12 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         #: so long runs may want a sparser cadence — a retry/resume then
         #: replays at most N-1 epochs from the last save.
         self.checkpoint_interval = max(1, int(checkpoint_interval))
+        #: device-placed batches the streaming feed keeps ahead of the train
+        #: step (None = the feed default / RDT_PREFETCH_TO_DEVICE, 2): H2D
+        #: for batch k+1 overlaps the compute of batch k — bit-identical to
+        #: synchronous placement (tests/test_feed_pipeline.py). The
+        #: device-resident path ignores it (nothing streams).
+        self.prefetch_to_device = prefetch_to_device
         self._result: Optional[TrainingResult] = None
 
     # ------------------------------------------------------------------ build
@@ -231,7 +238,8 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         if cache is None:
             feed = DeviceFeed(train_ds, self.batch_size, columns, mesh=mesh,
                               shuffle=self.shuffle, seed=self.seed,
-                              drop_remainder=self.drop_last)
+                              drop_remainder=self.drop_last,
+                              prefetch_to_device=self.prefetch_to_device)
         eval_feed = eval_cache = None
         eval_tail_ok = False
         if evaluate_ds is not None:
@@ -254,7 +262,8 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
             else:
                 eval_feed = DeviceFeed(evaluate_ds, self.batch_size, columns,
                                        mesh=mesh, shuffle=False,
-                                       drop_remainder=dp_total > 1)
+                                       drop_remainder=dp_total > 1,
+                                       prefetch_to_device=self.prefetch_to_device)
 
         state, history = self._train_loop(
             mesh, feed, eval_feed, ckpt_dir, max_retries=max_retries,
@@ -500,6 +509,10 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 train_loss = float(loss_sum) / steps if steps else float("nan")
                 t_sync = time.perf_counter() - ts
                 dt = time.perf_counter() - t0
+                # the feed's thread-side phase split (decode/stage/h2d): these
+                # walls OVERLAP dispatch by design (that is the prefetch win),
+                # so they attribute the epoch, they don't sum to it
+                pipe = feed.timings.take() if feed is not None else {}
                 report = {
                     "epoch": epoch,
                     "train_loss": train_loss,
@@ -507,6 +520,9 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                     "samples_per_s": samples / dt if dt > 0 else 0.0,
                     "epoch_time_s": dt,
                     "feed_time_s": t_feed,
+                    "decode_time_s": pipe.get("decode", 0.0),
+                    "stage_time_s": pipe.get("stage", 0.0),
+                    "h2d_time_s": pipe.get("h2d", 0.0),
                     "dispatch_time_s": t_disp,
                     "sync_time_s": t_sync,
                 }
@@ -718,6 +734,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         train_ds = DistributedDataset.from_portable(train_payload)
         feed = DeviceFeed(
             train_ds, self.batch_size, columns, mesh=mesh,
+            prefetch_to_device=self.prefetch_to_device,
             host_iter=GangShardIterator(
                 train_ds, self.batch_size, ctx.world_size, ctx.rank, columns,
                 shuffle=self.shuffle, seed=self.seed, row_range=row_range))
@@ -726,6 +743,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
             eval_ds = DistributedDataset.from_portable(eval_payload)
             eval_feed = DeviceFeed(
                 eval_ds, self.batch_size, columns, mesh=mesh,
+                prefetch_to_device=self.prefetch_to_device,
                 host_iter=GangShardIterator(
                     eval_ds, self.batch_size, ctx.world_size, ctx.rank,
                     columns, shuffle=False, seed=self.seed,
@@ -786,9 +804,10 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         models AND for ``batch_preprocessor`` / ``columns_spec`` models
         (e.g. DLRM): those decode the same column spec the train feed used
         and run the preprocessor in-jit per batch, exactly like the train
-        step. A ``label`` spec entry whose column(s) the dataset lacks (the
-        normal inference frame) is synthesized as zeros — the preprocessor's
-        label output is discarded anyway.
+        step. ANY spec entry whose column(s) the dataset lacks (the normal
+        inference frame's label — whatever the entry is keyed, a
+        preprocessor may name it anything) is synthesized as zeros — the
+        preprocessor's label output is discarded anyway.
         """
         import jax
         import jax.numpy as jnp
@@ -818,21 +837,43 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
         cols = dict(self._columns()) if custom else {
             "features": (self.feature_columns, self.feature_dtype)}
-        synth_label = None
-        if custom and "label" in cols:
-            lcols, ldt = cols["label"]
-            lnames = (lcols,) if isinstance(lcols, str) else tuple(lcols)
+        synth: Dict[str, Tuple[Tuple[str, ...], np.dtype]] = {}
+        if custom:
             have = set(ds.schema.names)
-            if not all(c in have for c in lnames):
-                cols.pop("label")
-                synth_label = np.dtype(ldt)
+            for name, (cspec, dt) in list(cols.items()):
+                cnames = (cspec,) if isinstance(cspec, str) else tuple(cspec)
+                missing = [c for c in cnames if c not in have]
+                if missing and len(missing) < len(cnames):
+                    # some of the entry's columns exist and some don't: that
+                    # is a schema mismatch (renamed/dropped feature), not a
+                    # label-less inference frame — zero-filling half a
+                    # feature matrix would silently predict garbage
+                    raise ValueError(
+                        f"columns_spec entry {name!r} is partially missing "
+                        f"from the dataset schema: missing {missing}")
+                if missing:
+                    # the entry is absent wholesale (the usual case: a label
+                    # column inference data never carries, under whatever key
+                    # the spec chose) — synthesize it as zeros
+                    cols.pop(name)
+                    synth[name] = (cnames, np.dtype(dt))
+                    logger.info("predict: columns_spec entry %r absent from "
+                                "the dataset schema; synthesizing zeros",
+                                name)
+            if not cols:
+                raise ValueError(
+                    "no columns_spec entry matches the dataset schema "
+                    f"{sorted(have)}; cannot synthesize every input")
         it = HostBatchIterator(ds, batch_size or self.batch_size, cols,
                                shuffle=False, drop_remainder=False)
         out = []
         for batch in it:
-            if synth_label is not None:
-                rows = len(next(iter(batch.values())))
-                batch["label"] = np.zeros((rows,), synth_label)
+            rows = len(next(iter(batch.values())))
+            for name, (cnames, dt) in synth.items():
+                # match the decoded shape contract of _as_numpy: one column
+                # decodes to [rows], several to [rows, n]
+                shape = (rows,) if len(cnames) == 1 else (rows, len(cnames))
+                batch[name] = np.zeros(shape, dt)
             out.append(np.asarray(infer(
                 {k: jnp.asarray(v) for k, v in batch.items()})))
         if not out:

@@ -85,6 +85,7 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
         fit_kwargs: Optional[Dict] = None,
         steps_per_dispatch: int = 1,
         checkpoint_interval: int = 1,
+        prefetch_to_device: Optional[int] = None,
     ):
         keras = _import_keras()
         if model is None and model_builder is None:
@@ -117,6 +118,10 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
         #: checkpoint every N-th epoch, final epoch always (see the flax
         #: twin; model.save of a keras archive can outweigh a resident epoch)
         self.checkpoint_interval = max(1, int(checkpoint_interval))
+        #: device-placed batches the streaming feed keeps ahead of the train
+        #: step (None = the feed default / RDT_PREFETCH_TO_DEVICE, 2) — see
+        #: the flax twin; bit-identical to synchronous placement
+        self.prefetch_to_device = prefetch_to_device
         self._trained_model = None
         self._result: Optional[TrainingResult] = None
 
@@ -221,7 +226,8 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
         if cache is None:
             feed = DeviceFeed(train_ds, self.batch_size, columns, mesh=mesh,
                               shuffle=self.shuffle, seed=self.seed,
-                              drop_remainder=self.drop_last)
+                              drop_remainder=self.drop_last,
+                              prefetch_to_device=self.prefetch_to_device)
         eval_feed = eval_cache = None
         if evaluate_ds is not None:
             dp_total = int(_np.prod([mesh.shape[a] for a in data_axes(mesh)]))
@@ -236,7 +242,8 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
             else:
                 eval_feed = DeviceFeed(evaluate_ds, self.batch_size, columns,
                                        mesh=mesh, shuffle=False,
-                                       drop_remainder=dp_total > 1)
+                                       drop_remainder=dp_total > 1,
+                                       prefetch_to_device=self.prefetch_to_device)
         model, history = self._stateless_train_loop(
             mesh, feed, eval_feed, ckpt_dir, max_retries=max_retries,
             cache=cache, eval_cache=eval_cache,
@@ -569,12 +576,18 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                 loss_host = float(loss_sum) / steps if steps else float("nan")
                 t_sync = _time.perf_counter() - ts
                 dt = _time.perf_counter() - t0
+                # the feed's thread-side decode/stage/h2d split — these walls
+                # OVERLAP dispatch (the prefetch win), see the flax twin
+                pipe = feed.timings.take() if feed is not None else {}
                 report = {
                     "epoch": epoch,
                     "loss": loss_host,
                     "epoch_time_s": dt,
                     "samples_per_s": samples / dt if dt > 0 else 0.0,
                     "feed_time_s": t_feed,
+                    "decode_time_s": pipe.get("decode", 0.0),
+                    "stage_time_s": pipe.get("stage", 0.0),
+                    "h2d_time_s": pipe.get("h2d", 0.0),
                     "dispatch_time_s": t_disp,
                     "sync_time_s": t_sync,
                 }
@@ -912,6 +925,7 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
         train_ds = DistributedDataset.from_portable(train_payload)
         feed = DeviceFeed(
             train_ds, self.batch_size, columns, mesh=mesh,
+            prefetch_to_device=self.prefetch_to_device,
             host_iter=GangShardIterator(
                 train_ds, self.batch_size, ctx.world_size, ctx.rank, columns,
                 shuffle=self.shuffle, seed=self.seed, row_range=row_range))
@@ -920,6 +934,7 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
             eval_ds = DistributedDataset.from_portable(eval_payload)
             eval_feed = DeviceFeed(
                 eval_ds, self.batch_size, columns, mesh=mesh,
+                prefetch_to_device=self.prefetch_to_device,
                 host_iter=GangShardIterator(
                     eval_ds, self.batch_size, ctx.world_size, ctx.rank,
                     columns, shuffle=False, seed=self.seed,

@@ -95,3 +95,49 @@ def test_dlrm_fit_sharded_embeddings(session):
     np.testing.assert_allclose(preds[:len(feats)],
                                np.asarray(manual).squeeze(-1),
                                rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_predict_synthesizes_nonstandard_label_key(session):
+    """ADVICE r5 #1: a columns_spec may key its label entry anything (the
+    batch_preprocessor consumes arbitrary keys) — predict() must synthesize
+    zeros for ANY spec entry whose columns the inference frame lacks, not
+    just the entry literally keyed "label"."""
+    import optax
+
+    from raydp_tpu.data import from_frame
+    from raydp_tpu.models import MLP
+    from raydp_tpu.train import FlaxEstimator
+
+    n = 512
+    rng = np.random.RandomState(0)
+    pdf = pd.DataFrame({"x1": rng.rand(n), "x2": rng.rand(n),
+                        "target": rng.rand(n)})
+    df = session.createDataFrame(pdf, num_partitions=2)
+
+    est = FlaxEstimator(
+        model=MLP(features=(8,), use_batch_norm=False),
+        optimizer=optax.adam(1e-2),
+        loss="mse",
+        batch_size=64,
+        num_epochs=2,
+        columns_spec={"features": (["x1", "x2"], np.float32),
+                      "target": ("target", np.float32)},
+        batch_preprocessor=lambda b: (b["features"], b["target"]),
+    )
+    est.fit_on_frame(df)
+
+    preds = est.predict(from_frame(df))
+    assert preds.shape == (n,) and np.isfinite(preds).all()
+
+    # the inference frame lacks "target": the entry is synthesized as zeros
+    # (its value is discarded by the preprocessor's label output anyway),
+    # so predictions are identical
+    preds_nolabel = est.predict(from_frame(df.drop("target")))
+    np.testing.assert_array_equal(preds_nolabel, preds)
+
+    # but a PARTIALLY-missing entry is a schema mismatch, not a label-less
+    # frame: synthesizing zeros for half a feature matrix would silently
+    # produce garbage predictions — it must raise instead
+    with pytest.raises(ValueError, match="partially"):
+        est.predict(from_frame(df.drop("x2")))

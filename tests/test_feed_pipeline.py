@@ -1,0 +1,212 @@
+"""The async double-buffered device feed (DevicePrefetcher) and its
+per-phase instrumentation (ISSUE 1 tentpole).
+
+Contract pinned here: the device-side prefetch stage only moves host
+staging + ``device_put`` OFF the consumer's critical path — it must never
+reorder, drop, or alter a batch (``prefetch_to_device=2`` bit-identical to
+``=0`` through both estimators), it must propagate producer errors and shut
+its threads down on early exit, and the ``decode/stage/h2d`` timers it
+feeds must surface in the estimators' epoch reports (the measured split
+VERDICT r5 Weak #2 asked for)."""
+
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from raydp_tpu.data.feed import DevicePrefetcher
+
+
+# --------------------------------------------------------------- unit level
+def test_device_prefetcher_order_and_values():
+    items = list(range(57))
+    out = list(DevicePrefetcher(iter(items), fn=lambda x: x * 2, depth=2))
+    assert out == [x * 2 for x in items]
+
+
+def test_device_prefetcher_propagates_producer_error():
+    def gen():
+        yield 1
+        raise RuntimeError("decode failed")
+
+    it = iter(DevicePrefetcher(gen(), depth=2))
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="decode failed"):
+        next(it)
+
+
+def test_device_prefetcher_early_exit_stops_producer():
+    """Abandoning the consumer mid-stream must stop the background thread
+    (an estimator error must not leak one producer thread per epoch)."""
+    produced = []
+
+    def gen():
+        for i in range(10_000):
+            produced.append(i)
+            yield i
+
+    stage = DevicePrefetcher(gen(), depth=2)
+    it = iter(stage)
+    assert next(it) == 0
+    it.close()
+    stage._thread.join(timeout=5.0)
+    assert not stage._thread.is_alive()
+    n = len(produced)
+    time.sleep(0.2)
+    assert len(produced) == n  # nothing produced after close
+
+
+def test_device_prefetcher_backpressure_bounds_readahead():
+    """The bounded queue is the backpressure: the producer can be at most
+    depth (queued) + 1 (in flight) + 1 (consumed) items ahead."""
+    pulled = []
+
+    def gen():
+        for i in range(100):
+            pulled.append(i)
+            yield i
+
+    stage = DevicePrefetcher(gen(), depth=2)
+    it = iter(stage)
+    assert next(it) == 0
+    time.sleep(0.3)  # let the producer run as far ahead as it can
+    assert len(pulled) <= 5
+    assert list(it) == list(range(1, 100))  # drains cleanly afterwards
+
+
+# ---------------------------------------------------------- estimator level
+def _linear_df(session, n=1344):
+    rng = np.random.RandomState(0)
+    x = rng.random_sample((n, 2))
+    y = x @ np.array([2.0, -3.0]) + 1.0
+    return session.createDataFrame(
+        pd.DataFrame({"x1": x[:, 0], "x2": x[:, 1], "y": y}),
+        num_partitions=4)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("chain", [1, 4])
+def test_flax_prefetch_to_device_parity(session, monkeypatch, chain):
+    """prefetch_to_device=2 must be BIT-IDENTICAL to =0 (same seed, same
+    shuffle): the async stage only overlaps placement with compute — and it
+    must compose with steps_per_dispatch chaining (the stacked path runs
+    through the same prefetcher)."""
+    import optax
+
+    from raydp_tpu.data import from_frame
+    from raydp_tpu.models import MLP
+    from raydp_tpu.train import FlaxEstimator
+
+    monkeypatch.setenv("RDT_DEVICE_CACHE", "0")  # pin the streaming feed
+    ds = from_frame(_linear_df(session))
+
+    def run(p2d):
+        est = FlaxEstimator(
+            model=MLP(features=(8,), use_batch_norm=False),
+            optimizer=optax.adam(1e-2),
+            loss="mse",
+            feature_columns=["x1", "x2"],
+            label_column="y",
+            batch_size=64,
+            num_epochs=2,
+            shuffle=True,
+            seed=0,
+            steps_per_dispatch=chain,
+            prefetch_to_device=p2d,
+        )
+        return est.fit(ds)
+
+    sync = run(0)
+    pipelined = run(2)
+    assert [r["steps"] for r in sync.history] == \
+        [r["steps"] for r in pipelined.history]
+    for a, b in zip(sync.history, pipelined.history):
+        assert a["train_loss"] == b["train_loss"]  # bit-identical
+
+
+@pytest.mark.slow
+def test_keras_prefetch_to_device_parity(session, monkeypatch):
+    """The keras twin of the parity contract, over the jitted stateless
+    loop."""
+    import os
+
+    os.environ.setdefault("KERAS_BACKEND", "jax")
+    import keras
+
+    from raydp_tpu.data import from_frame
+    from raydp_tpu.train import KerasEstimator
+
+    monkeypatch.setenv("RDT_DEVICE_CACHE", "0")
+    ds = from_frame(_linear_df(session, n=448))
+
+    def run(p2d):
+        model = keras.Sequential([
+            keras.layers.Input(shape=(2,)),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.Dense(1),
+        ])
+        est = KerasEstimator(model=model, optimizer="adam", loss="mse",
+                             feature_columns=["x1", "x2"], label_column="y",
+                             batch_size=64, num_epochs=2, shuffle=True,
+                             seed=0, prefetch_to_device=p2d)
+        return est.fit(ds)
+
+    sync = run(0)
+    pipelined = run(2)
+    assert len(sync.history) == len(pipelined.history) == 2
+    for a, b in zip(sync.history, pipelined.history):
+        assert a["loss"] == b["loss"]  # bit-identical
+
+
+@pytest.mark.slow
+def test_timing_split_surfaced_in_reports(session, monkeypatch):
+    """Streaming epochs report a positive decode/stage/h2d split; the
+    device-resident path reports zeros (nothing streamed). These keys are
+    what bench.py aggregates into the detail record's per-phase split."""
+    import optax
+
+    from raydp_tpu.data import from_frame
+    from raydp_tpu.models import MLP
+    from raydp_tpu.train import FlaxEstimator
+
+    ds = from_frame(_linear_df(session))
+
+    def run():
+        est = FlaxEstimator(
+            model=MLP(features=(8,), use_batch_norm=False),
+            optimizer=optax.adam(1e-2), loss="mse",
+            feature_columns=["x1", "x2"], label_column="y",
+            batch_size=64, num_epochs=2, shuffle=False,
+            steps_per_dispatch=4)
+        return est.fit(ds)
+
+    monkeypatch.setenv("RDT_DEVICE_CACHE", "0")
+    streamed = run()
+    for r in streamed.history:
+        assert r["decode_time_s"] > 0.0
+        assert r["stage_time_s"] > 0.0  # the chained np.stack assembly
+        assert r["h2d_time_s"] > 0.0
+
+    monkeypatch.setenv("RDT_DEVICE_CACHE", "1")
+    resident = run()
+    for r in resident.history:
+        assert r["decode_time_s"] == 0.0
+        assert r["stage_time_s"] == 0.0
+        assert r["h2d_time_s"] == 0.0
+
+
+def test_device_feed_prefetch_knob_env_default(session, monkeypatch):
+    """prefetch_to_device falls back to RDT_PREFETCH_TO_DEVICE (default 2);
+    an explicit argument wins."""
+    from raydp_tpu.data import from_frame
+    from raydp_tpu.data.feed import DeviceFeed
+
+    ds = from_frame(_linear_df(session, n=256))
+    cols = {"features": (["x1", "x2"], np.float32),
+            "label": ("y", np.float32)}
+    assert DeviceFeed(ds, 64, cols).prefetch_to_device == 2
+    monkeypatch.setenv("RDT_PREFETCH_TO_DEVICE", "5")
+    assert DeviceFeed(ds, 64, cols).prefetch_to_device == 5
+    assert DeviceFeed(ds, 64, cols,
+                      prefetch_to_device=0).prefetch_to_device == 0

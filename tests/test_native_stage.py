@@ -25,7 +25,7 @@ needs_native = pytest.mark.skipif(not native_stage_available(),
 
 
 @needs_native
-@pytest.mark.parametrize("dst", [np.float32, np.float64, np.int32, np.int64])
+@pytest.mark.parametrize("dst", [np.float32, np.float64])
 def test_stage_parity_mixed_source_dtypes(dst):
     rng = np.random.RandomState(0)
     table = pa.table({
@@ -37,6 +37,24 @@ def test_stage_parity_mixed_source_dtypes(dst):
         "i16": rng.randint(-300, 300, 777).astype(np.int16),
     })
     cols = ["f64", "f32", "i64", "i32", "u8", "i16"]
+    out = stage_table(table, cols, np.dtype(dst))
+    assert out is not None and out.dtype == np.dtype(dst)
+    np.testing.assert_array_equal(out, _numpy_path(table, cols, dst))
+
+
+@needs_native
+@pytest.mark.parametrize("dst", [np.int32, np.int64])
+def test_stage_parity_int_sources_to_int(dst):
+    """Integer→integer pairs stay on the kernel (float sources to an int dst
+    are declined — see test_stage_declines_float_to_int_pairs)."""
+    rng = np.random.RandomState(0)
+    table = pa.table({
+        "i64": rng.randint(-1000, 1000, 777),
+        "i32": rng.randint(-1000, 1000, 777).astype(np.int32),
+        "u8": rng.randint(0, 255, 777).astype(np.uint8),
+        "i16": rng.randint(-300, 300, 777).astype(np.int16),
+    })
+    cols = ["i64", "i32", "u8", "i16"]
     out = stage_table(table, cols, np.dtype(dst))
     assert out is not None and out.dtype == np.dtype(dst)
     np.testing.assert_array_equal(out, _numpy_path(table, cols, dst))
@@ -78,6 +96,35 @@ def test_stage_declines_ineligible_columns():
 
     ints = pa.table({"a": pa.array([1, 2]), "b": pa.array([3, 4])})
     assert stage_table(ints, ["a", "b"], np.dtype(np.float16)) is None
+
+
+@needs_native
+def test_stage_declines_float_to_int_pairs():
+    """ADVICE r5 #2: float→int static_cast is UB in C++ for NaN/out-of-range
+    values while numpy's astype is (different) platform-defined behavior —
+    the byte-parity contract cannot hold, so the kernel declines the pair
+    and the feed silently falls back to numpy."""
+    rng = np.random.RandomState(3)
+    table = pa.table({"a": rng.randn(64), "b": rng.randn(64)})
+    assert stage_table(table, ["a", "b"], np.dtype(np.int32)) is None
+    assert stage_table(table, ["a", "b"], np.dtype(np.int64)) is None
+
+    # one float source among ints declines the whole table (the numpy path
+    # redoes the full decode anyway)
+    mixed = pa.table({"a": pa.array([1.0, 2.0]), "b": pa.array([3, 4])})
+    assert stage_table(mixed, ["a", "b"], np.dtype(np.int64)) is None
+
+    # int→int and float→float pairs stay on the kernel
+    ints = pa.table({"a": pa.array([1, 2]), "b": pa.array([3, 4])})
+    assert stage_table(ints, ["a", "b"], np.dtype(np.int32)) is not None
+    assert stage_table(table, ["a", "b"], np.dtype(np.float32)) is not None
+
+    # the feed-level contract: _as_numpy still produces the numpy answer
+    from raydp_tpu.data.feed import _as_numpy
+
+    got = _as_numpy(table, ("a", "b"), np.int32)
+    np.testing.assert_array_equal(
+        got, _numpy_path(table, ["a", "b"], np.int32))
 
 
 @needs_native
