@@ -1,0 +1,147 @@
+"""ETL shuffle-byte minimization: optimizer on vs off, bytes moved + wall.
+
+The logical-plan optimizer (raydp_tpu/etl/optimizer.py) plus map-side partial
+aggregation turn the wide-operator path from "move everything, then compute"
+into "compute partials, move only what's needed". This bench runs groupby and
+join configs at two key cardinalities over a deliberately wide frame (key +
+8 payload columns, only 2 referenced), with `RDT_ETL_OPTIMIZER` off and on,
+and records per-config:
+
+- ``bytes_naive`` / ``bytes_opt`` — shuffled bytes from the engine's
+  per-stage shuffle ledger (``Engine.shuffle_stage_report()``; the counters
+  are serialized object-store payload sizes, not buffer-view estimates),
+- ``rows_naive`` / ``rows_opt`` — rows crossing the shuffle,
+- ``reduction_x`` — bytes_naive / bytes_opt,
+- ``wall_naive_s`` / ``wall_opt_s``,
+- ``identical`` — the two paths' results compared row-for-row after a
+  canonical sort (integer payloads, so aggregates are exact).
+
+The record lands in ``benchmarks/SHUFFLE_BYTES.json`` (override:
+``RDT_SHUFFLE_BYTES_PATH``). ``--smoke`` shrinks the data to seconds of
+wall and writes to /tmp by default so a CI smoke run cannot clobber the
+recorded artifact.
+
+Run: python benchmarks/shuffle_bench.py [--smoke]
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_frame(session, rows: int, cardinality: int, num_partitions: int):
+    rng = np.random.RandomState(7)
+    pdf = pd.DataFrame({"k": rng.randint(0, cardinality, rows)})
+    # wide payload: 8 int64 columns, of which the queries touch only 2 —
+    # projection pruning should drop the other 6 before any shuffle
+    for i in range(8):
+        pdf[f"c{i}"] = rng.randint(0, 1_000_000, rows)
+    return session.createDataFrame(pdf, num_partitions=num_partitions)
+
+
+def run_config(session, action, sort_keys):
+    """Run ``action`` with the optimizer off then on; return the record."""
+    from raydp_tpu.etl import optimizer
+
+    out = {}
+    tables = {}
+    for mode, env in (("naive", "0"), ("opt", "1")):
+        os.environ["RDT_ETL_OPTIMIZER"] = env
+        assert optimizer.enabled() == (env == "1")
+        session.engine.reset_shuffle_stage_report()
+        t0 = time.perf_counter()
+        table = action()
+        wall = time.perf_counter() - t0
+        report = session.engine.shuffle_stage_report()
+        out[f"bytes_{mode}"] = sum(r["bytes_shuffled"] for r in report)
+        out[f"rows_{mode}"] = sum(r["rows_shuffled"] for r in report)
+        out[f"wall_{mode}_s"] = round(wall, 4)
+        tables[mode] = table.sort_by([(k, "ascending") for k in sort_keys])
+    out["reduction_x"] = round(out["bytes_naive"] / max(out["bytes_opt"], 1), 2)
+    out["identical"] = tables["naive"].equals(tables["opt"])
+    out["stages_opt"] = [r["stage"] for r in
+                         session.engine.shuffle_stage_report()]
+    return out
+
+
+def main():
+    smoke = "--smoke" in sys.argv
+    rows = 4_000 if smoke else 400_000
+    parts = 4 if smoke else 8
+    default_path = ("/tmp/SHUFFLE_BYTES_SMOKE.json" if smoke else
+                    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "SHUFFLE_BYTES.json"))
+    out_path = os.environ.get("RDT_SHUFFLE_BYTES_PATH", default_path)
+
+    import raydp_tpu
+    from raydp_tpu.etl import functions as F
+
+    session = raydp_tpu.init("shuffle_bench", num_executors=2,
+                             executor_cores=2, executor_memory="1GB")
+    # discarded warmup: executor spin-up and first-touch costs must not land
+    # in the first measured config's wall_naive_s (naive runs first)
+    warm = make_frame(session, min(rows, 4000), 16, 2)
+    warm.groupBy("k").agg(F.count("c0").alias("n")).to_arrow()
+    session.engine.reset_shuffle_stage_report()
+    record = {
+        "metric": "etl_shuffle_bytes",
+        "unit": "bytes_naive/bytes_opt per config",
+        "rows": rows,
+        "smoke": smoke,
+        "configs": {},
+    }
+    try:
+        for name, card in (("low_card", 16), ("high_card", rows // 4)):
+            df = make_frame(session, rows, card, parts)
+
+            def groupby_action(frame=df):
+                return (frame.groupBy("k")
+                        .agg(F.sum("c0").alias("s0"),
+                             F.mean("c1").alias("m1"),
+                             F.count("c0").alias("n"))
+                        .to_arrow())
+
+            record["configs"][f"groupby_{name}"] = dict(
+                cardinality=card,
+                **run_config(session, groupby_action, ["k"]))
+
+            dim = session.createDataFrame(
+                pd.DataFrame({"k": np.arange(card),
+                              "label": np.arange(card) * 3}),
+                num_partitions=2)
+
+            def join_action(frame=df, d=dim):
+                return (frame.join(d, on="k")
+                        .select("k", "c0", "label")
+                        .to_arrow())
+
+            record["configs"][f"join_{name}"] = dict(
+                cardinality=card,
+                **run_config(session, join_action, ["k", "c0"]))
+    finally:
+        raydp_tpu.stop()
+
+    gb = record["configs"]["groupby_low_card"]
+    record["value"] = gb["reduction_x"]
+    record["all_identical"] = all(c["identical"]
+                                  for c in record["configs"].values())
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, "w") as fh:
+        json.dump(record, fh, indent=2, sort_keys=True)
+    print(json.dumps({k: v for k, v in record.items() if k != "configs"}))
+    for name, cfg in record["configs"].items():
+        print(f"{name}: bytes {cfg['bytes_naive']} -> {cfg['bytes_opt']} "
+              f"({cfg['reduction_x']}x), rows {cfg['rows_naive']} -> "
+              f"{cfg['rows_opt']}, wall {cfg['wall_naive_s']}s -> "
+              f"{cfg['wall_opt_s']}s, identical={cfg['identical']}")
+    return record
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,7 @@ recipes (SURVEY.md §5 failure-detection subsystem).
 
 from __future__ import annotations
 
+import collections
 import math
 import os
 import threading
@@ -22,6 +23,8 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
+from raydp_tpu import profiler
+from raydp_tpu.etl import optimizer as O
 from raydp_tpu.etl import plan as P
 from raydp_tpu.etl import tasks as T
 from raydp_tpu.etl.expressions import col as _col
@@ -175,6 +178,51 @@ class Engine:
         self.pool = pool
         self.shuffle_partitions = shuffle_partitions
         self.owner = owner
+        self._report_lock = threading.Lock()
+        # bounded per-engine shuffle-stage ledger (one entry per wide-op
+        # stage); benchmarks and tests read it through shuffle_stage_report()
+        self._stage_reports: "collections.deque[Dict[str, Any]]" = \
+            collections.deque(maxlen=256)
+
+    # ---- shuffle accounting -------------------------------------------------
+    def _record_stage(self, label: str, results: Sequence[Dict[str, Any]],
+                      num_buckets: int) -> None:
+        """Aggregate map-task shuffle counters into one stage entry and emit
+        a driver-side trace span carrying the totals as args."""
+        rows = sum(int(r.get("num_rows", 0)) for r in results)
+        nbytes = sum(int(r.get("shuffle_bytes", 0)) for r in results)
+        rows_in = sum(int(r.get("shuffle_rows_in", r.get("num_rows", 0)))
+                      for r in results)
+        bytes_in = sum(int(r.get("shuffle_bytes_in", 0)) for r in results)
+        entry = {"stage": label, "maps": len(results),
+                 "buckets": num_buckets,
+                 "rows_in": rows_in, "bytes_in": bytes_in,
+                 "rows_shuffled": rows, "bytes_shuffled": nbytes}
+        with self._report_lock:
+            self._stage_reports.append(entry)
+        with profiler.trace(f"shuffle:{label}", "etl", maps=len(results),
+                            buckets=num_buckets, rows_in=rows_in,
+                            bytes_in=bytes_in, rows_shuffled=rows,
+                            bytes_shuffled=nbytes):
+            pass
+
+    def shuffle_stage_report(self) -> List[Dict[str, Any]]:
+        """Per-stage shuffle ledger: one dict per wide-op stage executed by
+        this engine ({stage, maps, buckets, rows_in, bytes_in, rows_shuffled,
+        bytes_shuffled}); in = entering the shuffle stage (before map-side
+        partial aggregation), shuffled = what crossed the object store."""
+        with self._report_lock:
+            return [dict(e) for e in self._stage_reports]
+
+    def reset_shuffle_stage_report(self) -> None:
+        with self._report_lock:
+            self._stage_reports.clear()
+
+    @staticmethod
+    def _optimized(node: P.PlanNode) -> P.PlanNode:
+        """Plan rewrite applied at every action entry point; the naive
+        compile-verbatim path survives under RDT_ETL_OPTIMIZER=0."""
+        return O.optimize(node)
 
     def _num_buckets(self) -> int:
         """Reduce-side bucket count for wide operators: capped by the
@@ -207,7 +255,7 @@ class Engine:
         """Execute the plan; return per-partition (refs, schema bytes, row counts)."""
         temps: List[ObjectRef] = []
         try:
-            return self._materialize_inner(node, owner, temps)
+            return self._materialize_inner(self._optimized(node), owner, temps)
         finally:
             self._free(temps)
 
@@ -225,7 +273,7 @@ class Engine:
     def collect(self, node: P.PlanNode) -> pa.Table:
         temps: List[ObjectRef] = []
         try:
-            tasks, preferred = self._compile(node, temps)
+            tasks, preferred = self._compile(self._optimized(node), temps)
             tasks = [t.with_output(output=T.COLLECT) for t in tasks]
             results = self.pool.run_tasks(tasks, preferred)
             tables = [pa.ipc.open_stream(pa.py_buffer(r["ipc"])).read_all()
@@ -239,7 +287,7 @@ class Engine:
     def count(self, node: P.PlanNode) -> int:
         temps: List[ObjectRef] = []
         try:
-            tasks, preferred = self._compile(node, temps)
+            tasks, preferred = self._compile(self._optimized(node), temps)
             tasks = [t.with_output(output=T.ROWCOUNT) for t in tasks]
             results = self.pool.run_tasks(tasks, preferred)
             total = sum(r["num_rows"] for r in results)
@@ -261,7 +309,7 @@ class Engine:
         """
         temps: List[ObjectRef] = []
         try:
-            tasks, preferred = self._compile(node, temps)
+            tasks, preferred = self._compile(self._optimized(node), temps)
             cache_tasks, recover_blobs, keys = [], [], []
             for i, t in enumerate(tasks):
                 key = f"block_{frame_id}_{i}"
@@ -308,6 +356,7 @@ class Engine:
             ]
             results = self.pool.run_tasks(
                 map_tasks, self._locality([[r] for r in refs]))
+            self._record_stage("random-shuffle", results, nb)
             buckets = self._gather_buckets(results, nb, temps)
             reduce_tasks = [
                 self._task(T.ArrowRefSource(bucket, schema=schema_bytes),
@@ -324,7 +373,7 @@ class Engine:
     def num_partitions(self, node: P.PlanNode) -> int:
         temps: List[ObjectRef] = []
         try:
-            tasks, _ = self._compile(node, temps)
+            tasks, _ = self._compile(self._optimized(node), temps)
             return len(tasks)
         finally:
             self._free(temps)
@@ -495,14 +544,24 @@ class Engine:
     # ---- wide operators -----------------------------------------------------
     def _shuffle_children(self, node: P.PlanNode, num_buckets: int,
                           keys: Optional[List[str]], temps: List[ObjectRef],
-                          range_key=None) -> Tuple[List[List[ObjectRef]], Optional[bytes]]:
-        """Execute ``node`` with SHUFFLE output; transpose map×bucket → bucket×map."""
+                          range_key=None, pre_steps: Optional[List[T.Step]] = None,
+                          label: str = "shuffle",
+                          ) -> Tuple[List[List[ObjectRef]], Optional[bytes]]:
+        """Execute ``node`` with SHUFFLE output; transpose map×bucket → bucket×map.
+
+        ``pre_steps`` run on each map task AFTER the narrow chain and BEFORE
+        bucketing (the hook map-side partial aggregation uses); ``label`` names
+        the stage in the engine's shuffle ledger."""
         tasks, preferred = self._compile(node, temps)
-        tasks = [t.with_output(output=T.SHUFFLE, num_buckets=num_buckets,
+        extra = list(pre_steps or [])
+        tasks = [t.with_output(steps=t.steps + extra,
+                               shuffle_pre_steps=len(extra),
+                               output=T.SHUFFLE, num_buckets=num_buckets,
                                shuffle_keys=keys, range_key=range_key,
                                owner=self.owner)
                  for t in tasks]
         results = self.pool.run_tasks(tasks, preferred)
+        self._record_stage(label, results, num_buckets)
         schema = results[0]["schema"] if results else None
         return self._gather_buckets(results, num_buckets, temps), schema
 
@@ -518,15 +577,30 @@ class Engine:
             tasks = [self._task(T.ArrowRefSource(group, schema=schema))
                      for group in groups]
             return tasks, self._locality(groups)
-        buckets, schema = self._shuffle_children(node.child, n, keys=None, temps=temps)
+        buckets, schema = self._shuffle_children(node.child, n, keys=None,
+                                                 temps=temps, label="repartition")
         tasks = [self._task(T.ArrowRefSource(bucket, schema=schema))
                  for bucket in buckets]
         return tasks, self._locality(buckets)
 
     def _compile_groupagg(self, node: P.GroupAgg, temps: List[ObjectRef]):
         nb = self._num_buckets()
+        decomposable = all(f in O.DECOMPOSABLE_AGGS for _, f, _ in node.aggs)
+        if O.enabled() and decomposable:
+            # two-phase aggregation: partials computed map-side BEFORE the
+            # shuffle, so one row per (map task, key) crosses the store; the
+            # reduce side merges partials (mean = sum-of-sums / sum-of-counts)
+            partials, merges = T.decompose_aggs(node.aggs)
+            buckets, schema = self._shuffle_children(
+                node.child, nb, keys=node.keys, temps=temps,
+                pre_steps=[T.GroupAggPartialStep(node.keys, partials)],
+                label="groupagg-partial")
+            tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
+                                [T.GroupAggMergeStep(node.keys, merges)])
+                     for bucket in buckets]
+            return tasks, self._locality(buckets)
         buckets, schema = self._shuffle_children(node.child, nb, keys=node.keys,
-                                                 temps=temps)
+                                                 temps=temps, label="groupagg")
         tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
                             [T.GroupAggStep(node.keys, node.aggs)])
                  for bucket in buckets]
@@ -535,9 +609,10 @@ class Engine:
     def _compile_join(self, node: P.Join, temps: List[ObjectRef]):
         nb = self._num_buckets()
         left_buckets, lschema = self._shuffle_children(node.left, nb, node.keys,
-                                                       temps)
+                                                       temps, label="join-left")
         right_buckets, rschema = self._shuffle_children(node.right, nb,
-                                                        node.right_keys, temps)
+                                                        node.right_keys, temps,
+                                                        label="join-right")
         tasks = []
         for lb, rb in zip(left_buckets, right_buckets):
             tasks.append(self._task(
@@ -611,6 +686,7 @@ class Engine:
             for ref in refs
         ]
         results = self.pool.run_tasks(shuffle_tasks)
+        self._record_stage("sort-range", results, len(boundaries) + 1)
         buckets = self._gather_buckets(results, len(boundaries) + 1, temps)
         # buckets come out in global sort order for any direction mix (the
         # composite comparison honors per-key direction; nulls sort last)
@@ -627,7 +703,7 @@ class Engine:
         nb = self._num_buckets()
         keys = list(node.subset) if node.subset else ["*"]
         buckets, schema = self._shuffle_children(node.child, nb, keys=keys,
-                                                 temps=temps)
+                                                 temps=temps, label="distinct")
         tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
                             [T.DistinctStep(node.subset)])
                  for bucket in buckets]
@@ -660,7 +736,8 @@ class Engine:
         if node.partition_keys:
             nb = self._num_buckets()
             buckets, schema = self._shuffle_children(
-                child, nb, keys=list(node.partition_keys), temps=temps)
+                child, nb, keys=list(node.partition_keys), temps=temps,
+                label="window")
             tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
                                 list(steps))
                      for bucket in buckets]
@@ -679,7 +756,11 @@ class Engine:
         Spark's ``describe``."""
         temps: List[ObjectRef] = []
         try:
-            tasks, preferred = self._compile(node, temps)
+            # describe reads only `cols`: expose that to the optimizer by
+            # narrowing the plan root, so scans and shuffles below prune too
+            narrowed = (P.Project(node, [(c, _col(c)) for c in cols])
+                        if O.enabled() else node)
+            tasks, preferred = self._compile(self._optimized(narrowed), temps)
             tasks = [t.with_output(steps=t.steps + [T.DescribeStep(cols)],
                                    output=T.COLLECT)
                      for t in tasks]

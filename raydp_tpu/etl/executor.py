@@ -118,9 +118,24 @@ class EtlExecutor:
         from raydp_tpu import profiler
 
         task: T.Task = cloudpickle.loads(task_bytes)
+        pre = (int(getattr(task, "shuffle_pre_steps", 0) or 0)
+               if task.output == T.SHUFFLE else 0)
+        rows_in = bytes_in = None
         with profiler.trace(f"task:{type(task.source).__name__}", "etl",
                             task_id=task.task_id):
-            table = T.run_task_body(task)
+            if pre:
+                # run the narrow chain, measure what ENTERS the shuffle
+                # stage, then apply the shuffle-side steps (partial agg)
+                trimmed = task.with_output(steps=task.steps[:-pre])
+                table = T.run_task_body(trimmed)
+                rows_in, bytes_in = table.num_rows, table.nbytes
+                with profiler.trace("shuffle:map-partial", "etl",
+                                    task_id=task.task_id, rows_in=rows_in,
+                                    bytes_in=bytes_in):
+                    for step in task.steps[-pre:]:
+                        table = step.run(table)
+            else:
+                table = T.run_task_body(task)
         client = get_client()
         owner = task.owner
 
@@ -145,25 +160,47 @@ class EtlExecutor:
             }
 
         if task.output == T.SHUFFLE:
-            if task.range_key is not None:
-                key, boundaries, *rest = task.range_key
-                if isinstance(key, str):  # legacy single-key format
-                    buckets = T.range_buckets(table, key, boundaries,
-                                              nulls_high=bool(rest and rest[0]))
-                else:  # composite: key = [(name, order), ...]
-                    buckets = T.range_buckets_multi(table, key, boundaries)
-            elif task.shuffle_keys:
-                buckets = T.hash_buckets(table, task.shuffle_keys, task.num_buckets)
-            elif task.shuffle_seed is not None:
-                buckets = T.random_buckets(table, task.num_buckets,
-                                           task.shuffle_seed)
-            else:
-                start = T.hash_bytes(task.task_id) % max(task.num_buckets, 1)
-                buckets = T.round_robin_buckets(table, task.num_buckets, start)
+            with profiler.trace("shuffle:bucket", "etl",
+                                task_id=task.task_id,
+                                rows_in=table.num_rows):
+                if task.range_key is not None:
+                    key, boundaries, *rest = task.range_key
+                    if isinstance(key, str):  # legacy single-key format
+                        buckets = T.range_buckets(
+                            table, key, boundaries,
+                            nulls_high=bool(rest and rest[0]))
+                    else:  # composite: key = [(name, order), ...]
+                        buckets = T.range_buckets_multi(table, key, boundaries)
+                elif task.shuffle_keys:
+                    buckets = T.hash_buckets(table, task.shuffle_keys,
+                                             task.num_buckets)
+                elif task.shuffle_seed is not None:
+                    buckets = T.random_buckets(table, task.num_buckets,
+                                               task.shuffle_seed)
+                else:
+                    start = T.hash_bytes(task.task_id) % max(task.num_buckets, 1)
+                    buckets = T.round_robin_buckets(table, task.num_buckets,
+                                                    start)
             refs = [client.put_arrow(b, owner=owner) for b in buckets]
+            # ref.size is the serialized payload written to the store — the
+            # honest bytes-moved number (bucket tables are zero-copy slices,
+            # whose nbytes would overcount shared buffers)
+            shuffle_bytes = sum(int(getattr(r, "size", 0) or 0) for r in refs)
+            with profiler.trace("shuffle:write", "etl", task_id=task.task_id,
+                                rows_out=table.num_rows,
+                                bytes_out=shuffle_bytes):
+                pass
             return {
                 "bucket_refs": refs,
                 "num_rows": table.num_rows,
+                "shuffle_bytes": shuffle_bytes,
+                # pre-shuffle-stage size (differs from num_rows/bytes out
+                # when map-side partial aggregation ran; bytes_in is the
+                # in-memory table estimate, bytes out are serialized sizes)
+                "shuffle_rows_in": rows_in if rows_in is not None
+                else table.num_rows,
+                "shuffle_bytes_in": bytes_in if bytes_in is not None
+                else table.nbytes,
                 "schema": table.schema.serialize().to_pybytes(),
             }
 

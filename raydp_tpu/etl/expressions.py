@@ -99,6 +99,25 @@ class Expr:
     def __hash__(self):
         return id(self)
 
+    # -- analysis -------------------------------------------------------------
+    def references(self) -> "set[str]":
+        """Column names this expression reads — the optimizer's required-set
+        primitive. The generic walk covers every node whose operands live in
+        instance attributes (including tuples like ``When.branches``);
+        :class:`Column` overrides it as the base case."""
+        out: set = set()
+
+        def visit(v):
+            if isinstance(v, Expr):
+                out.update(v.references())
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    visit(item)
+
+        for v in self.__dict__.values():
+            visit(v)
+        return out
+
     # -- misc helpers ---------------------------------------------------------
     def is_null(self) -> "Expr":
         return UnaryOp("is_null", self)
@@ -193,6 +212,9 @@ class Column(Expr):
 
     def _name(self) -> str:
         return self.name
+
+    def references(self) -> "set[str]":
+        return {self.name}
 
     def __repr__(self):
         return f"col({self.name!r})"
@@ -491,6 +513,30 @@ def evaluate_to_array(expr: Expr, table: pa.Table):
     if isinstance(out, pa.Array):
         out = pa.chunked_array([out])
     return out
+
+
+def _substitute_value(v, mapping: Dict[str, str]):
+    if isinstance(v, Expr):
+        return substitute_columns(v, mapping)
+    if isinstance(v, tuple):
+        return tuple(_substitute_value(x, mapping) for x in v)
+    if isinstance(v, list):
+        return [_substitute_value(x, mapping) for x in v]
+    return v
+
+
+def substitute_columns(expr: Expr, mapping: Dict[str, str]) -> Expr:
+    """A structural copy of ``expr`` with every :class:`Column` renamed through
+    ``mapping`` (names absent from the mapping are kept). Used by the plan
+    optimizer to sink predicates below ``Rename`` nodes."""
+    import copy
+
+    if isinstance(expr, Column):
+        return Column(mapping.get(expr.name, expr.name))
+    clone = copy.copy(expr)
+    for k, v in list(clone.__dict__.items()):
+        clone.__dict__[k] = _substitute_value(v, mapping)
+    return clone
 
 
 # -- public constructors ------------------------------------------------------------

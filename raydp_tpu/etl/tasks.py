@@ -279,7 +279,9 @@ class DistinctStep(Step):
         if table.num_rows == 0:
             return table
         row_col = "__rdt_row__"
-        aug = table.append_column(
+        # dedupe on normalized keys (±0.0 group together) but keep the
+        # surviving rows' ORIGINAL values via the row-index take below
+        aug = normalize_group_keys(table, keys).append_column(
             row_col, pa.array(np.arange(table.num_rows, dtype=np.int64)))
         firsts = aug.group_by(keys).aggregate([(row_col, "min")])
         take = firsts.column(f"{row_col}_min").combine_chunks()
@@ -461,6 +463,20 @@ class LocalSortStep(Step):
         return table.sort_by(self.keys)
 
 
+def normalize_group_keys(table: pa.Table, keys: Sequence[str]) -> pa.Table:
+    """-0.0 → +0.0 in float key columns. Arrow's hash grouper (like our
+    ``hash_buckets``) distinguishes the two bit patterns even though the keys
+    compare equal, so a groupby/distinct would emit duplicate key rows.
+    Adding a typed zero flips only -0.0 (NaN/inf/null unchanged)."""
+    for k in keys:
+        i = table.schema.get_field_index(k)
+        column = table.column(i)
+        if pa.types.is_floating(column.type):
+            zero = pa.scalar(0.0, type=column.type)
+            table = table.set_column(i, k, pc.add(column, zero))
+    return table
+
+
 @dataclass
 class GroupAggStep(Step):
     """Local hash aggregation; correct as a whole when rows were shuffled by key."""
@@ -469,6 +485,7 @@ class GroupAggStep(Step):
     aggs: List[Tuple[str, str, str]]  # (input_col, agg_fn, output_name)
 
     def run(self, table: pa.Table) -> pa.Table:
+        table = normalize_group_keys(table, self.keys)
         agg_spec = [(c, f) for c, f, _ in self.aggs]
         out = table.group_by(self.keys).aggregate(agg_spec)
         # rename pyarrow's <col>_<fn> outputs to requested names
@@ -477,6 +494,134 @@ class GroupAggStep(Step):
             rename[f"{c}_{f}"] = name
         new_names = [rename.get(n, n) for n in out.column_names]
         return out.rename_columns(new_names)
+
+
+def decompose_aggs(aggs: List[Tuple[str, str, str]]
+                   ) -> Tuple[List[Tuple[str, str, str]],
+                              List[Tuple[str, str, List[str]]]]:
+    """Split decomposable aggregates into map-side partials + a reduce-side
+    merge plan (two-phase aggregation).
+
+    Returns ``(partials, merges)``: ``partials`` are ``(col, fn, partial_name)``
+    specs computed per map task BEFORE the shuffle (deduped, so ``mean`` +
+    ``sum`` over one column share a partial); ``merges`` are
+    ``(out_name, kind, partial_names)`` where ``kind`` is how the reduce side
+    combines partials — ``sum`` (also merges counts), ``min``/``max``, or
+    ``mean`` (sum-of-sums / sum-of-counts with a float64 divide)."""
+    partial_names: Dict[Tuple[str, str], str] = {}
+    partials: List[Tuple[str, str, str]] = []
+
+    def need(c: str, f: str) -> str:
+        key = (c, f)
+        if key not in partial_names:
+            name = f"__rdt_p_{f}_{c}"
+            partial_names[key] = name
+            partials.append((c, f, name))
+        return partial_names[key]
+
+    merges: List[Tuple[str, str, List[str]]] = []
+    for c, f, out in aggs:
+        if f == "mean":
+            merges.append((out, "mean", [need(c, "sum"), need(c, "count")]))
+        elif f == "count":
+            merges.append((out, "sum", [need(c, "count")]))
+        elif f == "sum":
+            merges.append((out, "sum", [need(c, "sum")]))
+        elif f in ("min", "max"):
+            merges.append((out, f, [need(c, f)]))
+        else:
+            raise ValueError(f"aggregate {f!r} is not decomposable")
+    return partials, merges
+
+
+@dataclass
+class GroupAggPartialStep(Step):
+    """Map-side partial aggregation: one row per (map task, key) crosses the
+    shuffle instead of every input row — the shuffle-byte reduction of
+    two-phase aggregation. Output columns: [keys..., partial names...].
+
+    High-cardinality guard: when a sampled prefix shows the keys are mostly
+    distinct, a hash aggregation would shrink nothing while paying a full
+    grouping pass per map task (the committed bench recorded +47% wall on
+    the 100k-cardinality config before this guard). In that case each row is
+    emitted AS its own partial — computed vectorized, no hash table: the
+    reduce-side merge is oblivious, a raw row is just a group of size 1."""
+
+    keys: List[str]
+    partials: List[Tuple[str, str, str]]  # (input_col, fn, partial_name)
+
+    #: sampled-prefix size and the distinct-fraction above which grouping is
+    #: judged not worth a per-map hash pass
+    SAMPLE_ROWS = 2048
+    DISTINCT_FRACTION = 0.5
+
+    def run(self, table: pa.Table) -> pa.Table:
+        table = normalize_group_keys(table, self.keys)
+        if self.keys and table.num_rows >= 256:
+            sample = table.select(self.keys).slice(0, self.SAMPLE_ROWS)
+            distinct = sample.group_by(self.keys).aggregate([]).num_rows
+            if distinct > self.DISTINCT_FRACTION * sample.num_rows:
+                return self._rowwise(table)
+        spec = [(c, f) for c, f, _ in self.partials]
+        out = table.group_by(self.keys).aggregate(spec)
+        rename = {f"{c}_{f}": name for c, f, name in self.partials}
+        return out.rename_columns(
+            [rename.get(n, n) for n in out.column_names])
+
+    def _rowwise(self, table: pa.Table) -> pa.Table:
+        """Per-row partials in the exact schema the grouped path emits (an
+        empty-slice group_by probes the aggregate output types, so e.g. an
+        int32 sum partial correctly widens to int64)."""
+        spec = [(c, f) for c, f, _ in self.partials]
+        probe = table.slice(0, 0).group_by(self.keys).aggregate(spec)
+        arrays = [table.column(k) for k in self.keys]
+        names = list(self.keys)
+        for c, f, name in self.partials:
+            typ = probe.schema.field(f"{c}_{f}").type
+            if f == "count":
+                # count of one value: 1 when valid, 0 when null (never null)
+                arr = pc.cast(pc.is_valid(table.column(c)), typ)
+            else:
+                # sum/min/max of one value is the value (null stays null, so
+                # the merge-side aggregate skips it, exactly like grouping)
+                arr = pc.cast(table.column(c), typ, safe=False)
+            arrays.append(arr)
+            names.append(name)
+        return pa.table(arrays, names=names)
+
+
+@dataclass
+class GroupAggMergeStep(Step):
+    """Reduce-side merge of map-side partials. Emits exactly the schema the
+    single-phase :class:`GroupAggStep` would: keys first, then one column per
+    requested aggregate, in order."""
+
+    keys: List[str]
+    merges: List[Tuple[str, str, List[str]]]  # (out_name, kind, partial_names)
+
+    def run(self, table: pa.Table) -> pa.Table:
+        spec, seen = [], set()
+        for _, kind, ops in self.merges:
+            pairs = ([(ops[0], "sum"), (ops[1], "sum")] if kind == "mean"
+                     else [(ops[0], kind)])
+            for p in pairs:
+                if p not in seen:
+                    seen.add(p)
+                    spec.append(p)
+        merged = table.group_by(self.keys).aggregate(spec)
+        arrays = [merged.column(k) for k in self.keys]
+        names = list(self.keys)
+        for out, kind, ops in self.merges:
+            if kind == "mean":
+                s = merged.column(f"{ops[0]}_sum")
+                c = merged.column(f"{ops[1]}_sum")
+                arr = pc.divide(pc.cast(s, pa.float64(), safe=False),
+                                pc.cast(c, pa.float64(), safe=False))
+            else:
+                arr = merged.column(f"{ops[0]}_{kind}")
+            arrays.append(arr)
+            names.append(out)
+        return pa.table(arrays, names=names)
 
 
 @dataclass
@@ -521,6 +666,10 @@ class Task:
     # (key, boundaries, nulls_high); legacy 2-tuples are tolerated
     range_key: Optional[Tuple[str, List, bool]] = None
     owner: Optional[str] = None                   # object-store owner for outputs
+    # how many TRAILING steps are shuffle-side (e.g. map-side partial
+    # aggregation): the executor measures rows/bytes entering the shuffle
+    # stage BEFORE these run, so the in/out counters show the reduction
+    shuffle_pre_steps: int = 0
 
     def with_output(self, **kw) -> "Task":
         d = self.__dict__.copy()
@@ -534,6 +683,53 @@ def run_task_body(task: Task) -> pa.Table:
     for step in task.steps:
         table = step.run(table)
     return table
+
+
+def split_by_bucket(table: pa.Table, bucket: np.ndarray,
+                    num_buckets: int) -> List[pa.Table]:
+    """One-pass bucket split: a single stable argsort + ``take`` + zero-copy
+    slices, replacing the per-bucket ``table.filter`` loop that scanned the
+    whole table once PER bucket (O(rows × buckets) passes). The stable sort
+    preserves original row order within each bucket, exactly like the
+    sequential filters did."""
+    order = np.argsort(bucket, kind="stable")
+    counts = np.bincount(bucket, minlength=num_buckets)
+    arranged = table.take(pa.array(order))
+    out, off = [], 0
+    for c in counts:
+        out.append(arranged.slice(off, int(c)))
+        off += int(c)
+    return out
+
+
+def _hash_string_like(arr: pa.Array) -> np.ndarray:
+    """Vectorized hash for string/other non-numeric key columns: dictionary-
+    encode (a single C++ pass), hash each DISTINCT value once, then gather by
+    index — the old path called ``str(v)`` + crc32 on every ROW via
+    ``to_pylist``. Dictionary-typed columns use their existing dictionary
+    directly instead of falling into the per-row slow path."""
+    if pa.types.is_dictionary(arr.type):
+        dict_arr = arr
+    else:
+        try:
+            dict_arr = pc.dictionary_encode(arr)
+        except pa.ArrowException:
+            # not dictionary-encodable (e.g. nested struct/list keys): keep
+            # the per-row path the pre-vectorized code used
+            return np.array([hash_bytes(str(v)) for v in arr.to_pylist()],
+                            dtype=np.uint64)
+    if isinstance(dict_arr, pa.ChunkedArray):
+        dict_arr = dict_arr.combine_chunks()
+    distinct = dict_arr.dictionary.to_pylist()
+    # one extra slot for nulls: fill_null routes null indices there, and the
+    # sentinel hashes like str(None) did on the old per-row path
+    h = np.empty(len(distinct) + 1, dtype=np.uint64)
+    for i, v in enumerate(distinct):
+        h[i] = hash_bytes(str(v))
+    h[len(distinct)] = hash_bytes(str(None))
+    idx = np.asarray(pc.fill_null(pc.cast(dict_arr.indices, pa.int64()),
+                                  len(distinct)))
+    return h[idx]
 
 
 def hash_buckets(table: pa.Table, keys: Sequence[str], num_buckets: int) -> List[pa.Table]:
@@ -553,13 +749,15 @@ def hash_buckets(table: pa.Table, keys: Sequence[str], num_buckets: int) -> List
         arr = table.column(k).combine_chunks()
         if pa.types.is_integer(arr.type) or pa.types.is_floating(arr.type):
             vals = np.asarray(pc.cast(arr, pa.float64(), safe=False).fill_null(np.nan))
+            # -0.0 == 0.0 but their bit patterns differ: equal keys must hash
+            # equal or a groupby emits duplicate key rows
+            vals = np.where(vals == 0.0, 0.0, vals)
             h = vals.view(np.uint64).copy()
         else:
-            h = np.array([hash_bytes(str(v)) for v in arr.to_pylist()],
-                         dtype=np.uint64)
+            h = _hash_string_like(arr)
         acc = acc * np.uint64(1000003) + h
     bucket = (acc % np.uint64(num_buckets)).astype(np.int64)
-    return [table.filter(pa.array(bucket == b)) for b in range(num_buckets)]
+    return split_by_bucket(table, bucket, num_buckets)
 
 
 def hash_bytes(s: str) -> int:
@@ -576,7 +774,7 @@ def random_buckets(table: pa.Table, num_buckets: int,
         return [table] * num_buckets
     rng = np.random.RandomState(seed)
     bucket = rng.randint(0, num_buckets, size=table.num_rows)
-    return [table.filter(pa.array(bucket == b)) for b in range(num_buckets)]
+    return split_by_bucket(table, bucket, num_buckets)
 
 
 def round_robin_buckets(table: pa.Table, num_buckets: int,
@@ -584,7 +782,7 @@ def round_robin_buckets(table: pa.Table, num_buckets: int,
     if table.num_rows == 0:
         return [table] * num_buckets
     idx = (np.arange(table.num_rows) + start) % num_buckets
-    return [table.filter(pa.array(idx == b)) for b in range(num_buckets)]
+    return split_by_bucket(table, idx, num_buckets)
 
 
 def range_buckets_multi(table: pa.Table, keys: List[Tuple[str, str]],
@@ -627,8 +825,7 @@ def range_buckets_multi(table: pa.Table, keys: List[Tuple[str, str]],
                 after = pc.or_(gt, pc.and_(eq, after))
         if after is not None:
             bucket += np.asarray(after, dtype=np.int64)
-    return [table.filter(pa.array(bucket == i))
-            for i in range(len(boundaries) + 1)]
+    return split_by_bucket(table, bucket, len(boundaries) + 1)
 
 
 def range_buckets(table: pa.Table, key: str, boundaries: List,
@@ -645,4 +842,4 @@ def range_buckets(table: pa.Table, key: str, boundaries: List,
     for b in boundaries:
         gt = pc.fill_null(pc.greater(col_arr, pa.scalar(b)), nulls_high)
         bucket += np.asarray(gt, dtype=np.int64)
-    return [table.filter(pa.array(bucket == i)) for i in range(len(boundaries) + 1)]
+    return split_by_bucket(table, bucket, len(boundaries) + 1)

@@ -124,8 +124,10 @@ MEASURED_CLAIM_FILES = [
     "benchmarks/ROOFLINE_LM.md",
     "benchmarks/gang_collective_microbench.py",
     "benchmarks/host_decode_bench.py",
+    "benchmarks/shuffle_bench.py",
     "bench.py",
     "doc/training.md",
+    "doc/etl.md",
     "README.md",
 ]
 
@@ -136,7 +138,7 @@ _MEASURED_RE = re.compile(
     # ms/step)
     r"measured(?:[^.\n]|\n(?!\n)){0,100}?"
     r"([0-9][\d,.]*\s*(?:k|M)?\s*(?:%?\s*MFU|tok/s|tokens/s"
-    r"|samples/s(?:/chip)?|ms/step))",
+    r"|samples/s(?:/chip)?|ms/step|×\s*fewer\s+shuffled\s+bytes))",
     re.I)
 
 

@@ -1,0 +1,33 @@
+"""shuffle_bench.py --smoke must keep working (tier-1-safe, tiny data): the
+bench harness backing benchmarks/SHUFFLE_BYTES.json cannot rot silently."""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_shuffle_bench_smoke(tmp_path):
+    out_path = tmp_path / "SHUFFLE_BYTES_SMOKE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RDT_SHUFFLE_BYTES_PATH=str(out_path))
+    env.pop("RDT_ETL_OPTIMIZER", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks", "shuffle_bench.py"),
+         "--smoke"],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    record = json.loads(out_path.read_text())
+    assert record["metric"] == "etl_shuffle_bytes" and record["smoke"]
+    configs = record["configs"]
+    assert set(configs) == {"groupby_low_card", "join_low_card",
+                            "groupby_high_card", "join_high_card"}
+    for name, cfg in configs.items():
+        assert cfg["identical"], name
+        assert 0 < cfg["bytes_opt"] < cfg["bytes_naive"], name
+    # the headline: low-cardinality groupby shuffles a small multiple of
+    # cardinality rows instead of every input row
+    assert configs["groupby_low_card"]["reduction_x"] >= 5.0
+    assert record["all_identical"] is True
