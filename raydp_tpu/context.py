@@ -176,6 +176,15 @@ def init(
     and stored data outlive this driver; ``stop(cleanup_data=False)`` leaves
     even this session's master alive for the next driver to read.
     """
+    # re-arm the fault plane from the CURRENT env: the process-local registry
+    # caches RDT_FAULTS on first check(), so a spec exported between two
+    # sessions of one driver process would otherwise never load for
+    # driver-side sites (rpc.call, store.get) and silently inject nothing.
+    # Rules armed via faults.inject() before init survive (only env rules
+    # reload)
+    from raydp_tpu import faults
+    faults.reset()
+
     sub = _submit_overrides()
     app_name = app_name or sub.get("app_name") or "raydp-tpu"
     if num_executors is None:

@@ -11,9 +11,13 @@ recipes (SURVEY.md §5 failure-detection subsystem).
 from __future__ import annotations
 
 import collections
+import heapq
 import math
 import os
+import random
+import re
 import threading
+import time
 import uuid
 from concurrent.futures import FIRST_COMPLETED, wait
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -30,7 +34,7 @@ from raydp_tpu.etl import tasks as T
 from raydp_tpu.etl.expressions import col as _col
 from raydp_tpu.log import get_logger
 from raydp_tpu.runtime.actor import ActorHandle
-from raydp_tpu.runtime.object_store import ObjectRef, get_client
+from raydp_tpu.runtime.object_store import HEAD_HOST, ObjectRef, get_client
 from raydp_tpu.runtime.rpc import ConnectionLost, RemoteError
 
 logger = get_logger("etl.engine")
@@ -38,6 +42,154 @@ logger = get_logger("etl.engine")
 
 class StageError(RuntimeError):
     pass
+
+
+class ObjectsLostError(StageError):
+    """A stage task read intermediates whose store blobs are gone (host died,
+    payload dropped). Retrying the consumer replays the miss, so the pool
+    fails the stage immediately and hands the engine the lost ids for lineage
+    recovery (regenerate producers → patch consumer refs → resubmit)."""
+
+    def __init__(self, message: str, lost_ids: Sequence[str]):
+        super().__init__(message)
+        self.lost_ids = list(lost_ids)
+        #: completed per-task results at abort time (index-aligned, None =
+        #: unfinished) — recovery resubmits only the unfinished tasks instead
+        #: of redoing the whole stage per round
+        self.partial: Optional[List[Optional[Dict[str, Any]]]] = None
+
+
+#: object ids travel inside ``RemoteError`` messages (see
+#: ``object_store.ObjectLostError``); ids are 32 hex chars (token_hex(16))
+_OBJECT_ID_RE = re.compile(r"\b[0-9a-f]{32}\b")
+
+
+def _lost_ids_of(err: RemoteError) -> List[str]:
+    """Lost object ids carried by a remote ObjectLostError: the structured
+    ``object_id`` field when present, falling back to the 32-hex tokens in
+    the message text (a peer running older code)."""
+    oid = getattr(err, "object_id", None)
+    if oid:
+        return [oid]
+    return _OBJECT_ID_RE.findall(err.message or "")
+
+#: task-retry backoff: exponential with full jitter, replacing the old
+#: immediate hot-loop resubmit (a restarting executor or a transient store
+#: hiccup needs breathing room, and jitter de-synchronizes sibling retries)
+_RETRY_BACKOFF_BASE_S = 0.05
+_RETRY_BACKOFF_CAP_S = 2.0
+
+#: how long an executor marked unreachable is skipped by task placement
+#: before being probed again (restarts re-register under the same name)
+_DOWN_TTL_S = 10.0
+
+
+def _backoff_delay(attempt: int, rng: random.Random,
+                   base: float = _RETRY_BACKOFF_BASE_S,
+                   cap: float = _RETRY_BACKOFF_CAP_S) -> float:
+    """Exponential backoff with jitter for the ``attempt``-th retry
+    (1-based): ``min(cap, base * 2^(attempt-1) * U(0.5, 1.5))`` — the cap is
+    a hard bound on the returned delay, jitter included."""
+    return min(cap,
+               base * (2 ** max(0, attempt - 1)) * (0.5 + rng.random()))
+
+
+def _result_refs(r: Dict[str, Any]) -> List[ObjectRef]:
+    """Store refs a task result carries (shuffle buckets and/or RETURN_REF)."""
+    refs = list(r.get("bucket_refs") or [])
+    if r.get("ref") is not None:
+        refs.append(r["ref"])
+    return refs
+
+
+def _free_result_refs(results: Sequence[Optional[Dict[str, Any]]]) -> None:
+    """Free every output in a failed stage's completed results — they will
+    never reach a caller, so left alone they would orphan in the store."""
+    orphans = [ref for r in results if r is not None for ref in _result_refs(r)]
+    if orphans:
+        try:
+            get_client().free(orphans)
+        except Exception:
+            logger.warning("failed to free %d orphaned outputs of a "
+                           "failed stage", len(orphans))
+
+
+#: how long a failing stage waits for its in-flight tasks before abandoning
+#: them (their outputs would otherwise be orphaned in the store)
+_DRAIN_TIMEOUT_S = 30.0
+
+
+def _recovery_enabled() -> bool:
+    """Lineage recovery kill switch; read per action so tests can flip it."""
+    v = os.environ.get("RDT_LINEAGE_RECOVERY", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+def _recovery_rounds() -> int:
+    """Recovery attempts per stage (each round may regenerate several blobs)."""
+    return int(os.environ.get("RDT_LINEAGE_ROUNDS", "4") or 0)
+
+
+def _recovery_depth() -> int:
+    """Max transitive producer-of-producer regeneration depth."""
+    return int(os.environ.get("RDT_LINEAGE_DEPTH", "4") or 0)
+
+
+def _unreachable_grace_s() -> float:
+    """How long a stage keeps probing for a reachable executor before failing.
+    An executor restart is a process spawn plus the jax/pyarrow import storm —
+    tens of seconds on a loaded machine — so "cannot reach" must not burn the
+    task-retry budget (~7s of capped backoff): submits rotate to live
+    executors immediately and only give up after this wall-clock grace."""
+    return float(os.environ.get("RDT_EXECUTOR_WAIT_S", "60") or 0)
+
+
+class _Producer:
+    """Ledger entry: the serialized task that created a set of intermediates
+    (all shuffle buckets of one map task, or one RETURN_REF block), in output
+    order — rerunning the task yields byte-identical replacements because
+    every task is a deterministic recipe (seeded sampling, stable hashing)."""
+
+    __slots__ = ("task_bytes", "outputs", "label", "entry")
+
+    def __init__(self, task_bytes: bytes, outputs: List[str], label: str):
+        self.task_bytes = task_bytes
+        self.outputs = outputs
+        self.label = label
+        #: the shuffle-report entry of the producing stage, bound by
+        #: _record_stage — recovery attribution goes HERE, so two same-label
+        #: stages in one action (two joins, two groupbys) stay distinct
+        self.entry: Optional[Dict[str, Any]] = None
+
+
+class _ActionTemps(list):
+    """Per-action intermediate registry: the list half is the free-at-action-
+    end set (what ``temps`` always was); ``lineage`` maps every intermediate
+    object id to its producer so a lost blob can be regenerated mid-action."""
+
+    def __init__(self):
+        super().__init__()
+        self.lineage: Dict[str, _Producer] = {}
+        #: accumulated old-id → regenerated-ref patches from every recovery
+        #: in this action; anything serialized for later use (e.g. cache
+        #: recover recipes) must be patched through this map, or it would
+        #: bake in ids whose blobs are already dead
+        self.ref_patches: Dict[str, ObjectRef] = {}
+        #: label → the report entry THIS action recorded (aliases the dict in
+        #: the engine deque), so recovery attribution lands on this action's
+        #: stage even when a concurrent action logged the same label later
+        self.stage_entries: Dict[str, Dict[str, Any]] = {}
+
+    def apply_patches(self, mapping: Dict[str, ObjectRef]) -> None:
+        """Fold a recovery round's old-id → fresh-ref mapping into the
+        action's accumulated patches, collapsing transitively: an earlier
+        round's patch target may ITSELF be what just got regenerated, and
+        anything serialized later (cache recover recipes) must point at the
+        live blob, not a dead intermediate generation."""
+        for k, v in self.ref_patches.items():
+            if v.id in mapping:
+                self.ref_patches[k] = mapping[v.id]
+        self.ref_patches.update(mapping)
 
 
 def _root_limit(node: P.PlanNode) -> Optional[int]:
@@ -112,14 +264,36 @@ class ExecutorPool:
         tasks: Sequence[T.Task],
         preferred: Optional[Sequence[Optional[str]]] = None,
         max_inflight_per_executor: int = 4,
+        payloads: Optional[Sequence[bytes]] = None,
     ) -> List[Dict[str, Any]]:
-        """Run tasks, preserving order of results; blocks until all complete."""
+        """Run tasks, preserving order of results; blocks until all complete.
+
+        Failed attempts resubmit after exponential backoff with full jitter
+        (never the old immediate hot loop). A task that read a LOST store
+        blob fails the stage at once as :class:`ObjectsLostError` — retrying
+        the consumer replays the miss; only lineage recovery (the engine's
+        job) can fix it. Any stage abort first cancels queued retries, drains
+        in-flight tasks, and frees the outputs the caller will never see."""
         n = len(tasks)
         results: List[Optional[Dict[str, Any]]] = [None] * n
         attempts = [0] * n
         max_inflight = max(1, max_inflight_per_executor * len(self.executors))
         pending: Dict[Any, Tuple[int, str]] = {}
+        retry_q: List[Tuple[float, int]] = []  # (due monotonic, task index)
+        rng = random.Random()
         next_idx = 0
+        # serialize each task at most once (caller-provided payloads — e.g.
+        # the engine's lineage ledger copies — are reused; retries too)
+        blobs: List[Optional[bytes]] = list(payloads) if payloads is not None \
+            else [None] * n
+
+        down: Dict[str, float] = {}  # name -> monotonic time marked down
+        uprobe = [0] * n             # unreachable-submit probes per task
+        unreach_since: List[Optional[float]] = [None] * n
+
+        def _is_down(ename: str) -> bool:
+            t = down.get(ename)
+            return t is not None and time.monotonic() - t < _DOWN_TTL_S
 
         def _submit(i: int):
             name = None
@@ -127,28 +301,89 @@ class ExecutorPool:
                     and attempts[i] == 0:
                 name = preferred[i]
             handle = self.by_name.get(name) if name else None
-            if handle is None:
+            if handle is None or _is_down(handle.name or ""):
+                # rotate past executors recently seen unreachable: a task
+                # whose preferred executor died must land on a live one (a
+                # lost cache block rebuilds from its lineage recipe there)
                 handle = self._next_executor()
-            payload = cloudpickle.dumps(tasks[i])
+                for _ in range(len(self.executors)):
+                    if not _is_down(handle.name or ""):
+                        break
+                    handle = self._next_executor()
+            if blobs[i] is None:
+                blobs[i] = cloudpickle.dumps(tasks[i])
+            payload = blobs[i]
             try:
                 fut = handle.submit("run_task", payload)
             except (ConnectionLost, OSError) as e:
-                raise StageError(f"cannot reach executor {handle.name}: {e}") from e
+                # a crashed executor's address refuses connections until the
+                # supervisor re-homes it — and a restart is a process spawn
+                # plus the jax import storm, tens of seconds under load. That
+                # must not burn the task-retry budget: mark the executor
+                # down, rotate, and keep probing within a wall-clock grace.
+                hname = handle.name or ""
+                now = time.monotonic()
+                down[hname] = now
+                if unreach_since[i] is None:
+                    unreach_since[i] = now
+                uprobe[i] += 1
+                if now - unreach_since[i] > _unreachable_grace_s():
+                    raise StageError(
+                        f"no reachable executor for task "
+                        f"{tasks[i].task_id} after {uprobe[i]} probes over "
+                        f"{now - unreach_since[i]:.0f}s: {e}") from e
+                delay = _backoff_delay(uprobe[i], rng)
+                logger.warning("submit of task %s to %s failed (probe %d, "
+                               "retry in %.2fs): %s", tasks[i].task_id,
+                               hname, uprobe[i], delay, e)
+                heapq.heappush(retry_q, (now + delay, i))
+                return
+            unreach_since[i] = None
+            uprobe[i] = 0
             pending[fut] = (i, handle.name or "")
 
-        while next_idx < n and len(pending) < max_inflight:
-            _submit(next_idx)
-            next_idx += 1
+        try:
+            while next_idx < n and len(pending) < max_inflight:
+                _submit(next_idx)
+                next_idx += 1
 
-        while pending:
-            done, _ = wait(list(pending.keys()), return_when=FIRST_COMPLETED)
-            for fut in done:
-                i, ename = pending.pop(fut)
-                err = fut.exception()
-                if err is None:
-                    results[i] = fut.result()
-                else:
+            while pending or retry_q:
+                now = time.monotonic()
+                while retry_q and retry_q[0][0] <= now \
+                        and len(pending) < max_inflight:
+                    _, i = heapq.heappop(retry_q)
+                    _submit(i)
+                if not pending:
+                    if retry_q:
+                        time.sleep(max(0.0, min(
+                            retry_q[0][0] - time.monotonic(),
+                            _RETRY_BACKOFF_CAP_S)))
+                        continue
+                    break
+                # a due retry only shortens the wait when a slot is free to
+                # take it — otherwise timeout=0 would busy-spin against a
+                # full pool until some in-flight task completes
+                timeout = max(0.0, retry_q[0][0] - time.monotonic()) \
+                    if retry_q and len(pending) < max_inflight else None
+                done, _ = wait(list(pending.keys()), timeout=timeout,
+                               return_when=FIRST_COMPLETED)
+                for fut in done:
+                    i, ename = pending.pop(fut)
+                    err = fut.exception()
+                    if err is None:
+                        results[i] = fut.result()
+                        continue
+                    if isinstance(err, ConnectionLost) and ename:
+                        # the executor died mid-task: steer the resubmit (and
+                        # every sibling) away from it while it restarts
+                        down[ename] = time.monotonic()
                     attempts[i] += 1
+                    if isinstance(err, RemoteError) \
+                            and err.exc_type == "ObjectLostError":
+                        lost = _lost_ids_of(err)
+                        raise ObjectsLostError(
+                            f"task {tasks[i].task_id} read lost store "
+                            f"objects {lost}: {err.message}", lost) from err
                     if (isinstance(err, RemoteError)
                             and err.exc_type in _NO_RETRY_EXC_TYPES):
                         raise StageError(
@@ -157,14 +392,108 @@ class ExecutorPool:
                         raise StageError(
                             f"task {tasks[i].task_id} failed after "
                             f"{attempts[i]} attempts: {err}") from err
-                    logger.warning("task %s failed on %s (attempt %d): %s",
-                                   tasks[i].task_id, ename, attempts[i],
-                                   str(err).splitlines()[0] if str(err) else err)
-                    _submit(i)
-            while next_idx < n and len(pending) < max_inflight:
-                _submit(next_idx)
-                next_idx += 1
+                    delay = _backoff_delay(attempts[i], rng)
+                    logger.warning(
+                        "task %s failed on %s (attempt %d, retry in %.2fs): %s",
+                        tasks[i].task_id, ename, attempts[i], delay,
+                        str(err).splitlines()[0] if str(err) else err)
+                    heapq.heappush(retry_q, (time.monotonic() + delay, i))
+                while next_idx < n and len(pending) < max_inflight:
+                    _submit(next_idx)
+                    next_idx += 1
+        except ObjectsLostError as e:
+            # keep completed results: the engine reuses them after lineage
+            # recovery (their outputs are its responsibility from here on).
+            # Sibling consumers failing on OTHER lost blobs surface during
+            # the drain — harvesting their ids lets one recovery round
+            # regenerate everything a dead host took, not one blob per round.
+            more = self._drain_merge(pending, results, retry_q)
+            e.lost_ids = list(dict.fromkeys(e.lost_ids + more))
+            e.partial = list(results)
+            raise
+        except Exception:
+            # ANY stage failure (StageError or an unexpected driver-side
+            # error, e.g. an injected rpc fault) runs the abort contract:
+            # cancel queued retries, drain in-flight tasks, free outputs
+            self._abort_stage(pending, results, retry_q)
+            raise
         return results  # type: ignore[return-value]
+
+    def _drain_merge(self, pending: Dict[Any, Tuple[int, str]],
+                     results: List[Optional[Dict[str, Any]]],
+                     retry_q: List[Tuple[float, int]]) -> List[str]:
+        """Stage abort: cancel queued resubmits and drain in-flight tasks
+        KEEPING whatever completed — unlike :meth:`_abort_stage`, nothing is
+        freed, because the caller either resubmits around these results or
+        frees them itself when recovery gives up. Returns lost object ids
+        harvested from tasks that failed lost-blob during the drain."""
+        retry_q.clear()
+        lost: List[str] = []
+        if not pending:
+            return lost
+        done, not_done = wait(list(pending.keys()), timeout=_DRAIN_TIMEOUT_S)
+        if not_done:
+            logger.warning(
+                "abandoning %d in-flight tasks still running %.0fs after a "
+                "stage abort; their outputs free on completion",
+                len(not_done), _DRAIN_TIMEOUT_S)
+            for fut in not_done:
+                # whenever the straggler finally lands, free what it wrote —
+                # its output is in neither results nor temps, so nothing
+                # else would ever release it
+                fut.add_done_callback(self._free_late_result)
+        for fut in done:
+            i, _ = pending[fut]
+            err = fut.exception()
+            if err is None:
+                results[i] = fut.result()
+            elif isinstance(err, RemoteError) \
+                    and err.exc_type == "ObjectLostError":
+                lost.extend(_lost_ids_of(err))
+        pending.clear()
+        return lost
+
+    def _free_late_result(self, fut) -> None:
+        """Completion callback for a task abandoned past the drain timeout:
+        free its store outputs, and drop a late-cached block from its
+        executor — the block landed AFTER the aborting action's prefix sweep
+        ran, and each persist() uses a fresh frame id, so no later sweep
+        would ever target it (it would pin executor RAM forever).
+
+        The work runs on a throwaway daemon thread: this callback fires on
+        the executor connection's RPC read loop, and ``drop_blocks`` is a
+        synchronous call over that same connection — issued inline it would
+        block the only thread able to deliver its own response, wedging the
+        connection for every later task on that executor."""
+        threading.Thread(target=self._free_late_result_sync, args=(fut,),
+                         daemon=True, name="rdt-free-late-result").start()
+
+    def _free_late_result_sync(self, fut) -> None:
+        try:
+            err = fut.exception()
+            if err is None:
+                res = fut.result()
+                _free_result_refs([res])
+                key = res.get("cache_key")
+                if key is not None:
+                    h = self.by_name.get(res.get("executor"))
+                    if h is not None:
+                        # stamp-conditioned: a lineage-recovery resubmit of
+                        # this same task may have re-cached the key on this
+                        # executor; only OUR stale generation must go
+                        h.drop_blocks([key], res.get("cache_stamp"))
+        except Exception:
+            pass  # store/executor may already be shut down; nothing to salvage
+
+    def _abort_stage(self, pending: Dict[Any, Tuple[int, str]],
+                     results: List[Optional[Dict[str, Any]]],
+                     retry_q: List[Tuple[float, int]]) -> None:
+        """The stage is failing: cancel queued resubmits, wait out tasks that
+        are still executing on the pool (there is no remote cancel — draining
+        is what keeps them from writing into the store after the driver has
+        given up), and free every output the caller will never receive."""
+        self._drain_merge(pending, results, retry_q)
+        _free_result_refs(results)
 
 
 class Engine:
@@ -183,10 +512,12 @@ class Engine:
         # stage); benchmarks and tests read it through shuffle_stage_report()
         self._stage_reports: "collections.deque[Dict[str, Any]]" = \
             collections.deque(maxlen=256)
+        self._retry_rng = random.Random()  # jitter for recovery resubmits
 
     # ---- shuffle accounting -------------------------------------------------
     def _record_stage(self, label: str, results: Sequence[Dict[str, Any]],
-                      num_buckets: int) -> None:
+                      num_buckets: int,
+                      temps: Optional[List[ObjectRef]] = None) -> None:
         """Aggregate map-task shuffle counters into one stage entry and emit
         a driver-side trace span carrying the totals as args."""
         rows = sum(int(r.get("num_rows", 0)) for r in results)
@@ -197,9 +528,23 @@ class Engine:
         entry = {"stage": label, "maps": len(results),
                  "buckets": num_buckets,
                  "rows_in": rows_in, "bytes_in": bytes_in,
-                 "rows_shuffled": rows, "bytes_shuffled": nbytes}
+                 "rows_shuffled": rows, "bytes_shuffled": nbytes,
+                 # lineage-recovery accounting: blobs regenerated for this
+                 # stage's intermediates, and how many recovery events ran
+                 "regenerated": 0, "recovered": 0}
         with self._report_lock:
             self._stage_reports.append(entry)
+            if isinstance(temps, _ActionTemps):
+                temps.stage_entries[label] = entry
+                # bind the entry to the producers just ledgered for these
+                # results, so recovery attributes to THIS stage even after
+                # a later same-label stage overwrites stage_entries[label]
+                for r in results:
+                    for ref in _result_refs(r):
+                        prod = temps.lineage.get(ref.id)
+                        if prod is not None and prod.label == label \
+                                and prod.entry is None:
+                            prod.entry = entry
         with profiler.trace(f"shuffle:{label}", "etl", maps=len(results),
                             buckets=num_buckets, rows_in=rows_in,
                             bytes_in=bytes_in, rows_shuffled=rows,
@@ -209,10 +554,38 @@ class Engine:
     def shuffle_stage_report(self) -> List[Dict[str, Any]]:
         """Per-stage shuffle ledger: one dict per wide-op stage executed by
         this engine ({stage, maps, buckets, rows_in, bytes_in, rows_shuffled,
-        bytes_shuffled}); in = entering the shuffle stage (before map-side
-        partial aggregation), shuffled = what crossed the object store."""
+        bytes_shuffled, regenerated, recovered}); in = entering the shuffle
+        stage (before map-side partial aggregation), shuffled = what crossed
+        the object store. ``regenerated`` counts intermediate blobs rebuilt
+        through lineage recovery after a store loss, ``recovered`` the
+        recovery events that rebuilt them (0/0 on a fault-free run)."""
         with self._report_lock:
             return [dict(e) for e in self._stage_reports]
+
+    def _note_recovery(self, prod: _Producer, num_blobs: int,
+                       temps: "_ActionTemps") -> None:
+        """Attribute a lineage-recovery event to the entry of the stage that
+        produced the lost blobs — the producer's own binding first (distinct
+        for two same-label stages in one action), then the action's entry for
+        that label; concurrent actions may interleave same-label entries in
+        the engine deque, so "most recent with this label" would be the wrong
+        stage exactly when two actions shuffle at once. A label the action
+        never recorded (e.g. a ``materialize``) gets a bare entry with zero
+        shuffle counters, registered so repeat recoveries accumulate."""
+        with self._report_lock:
+            entry = prod.entry
+            if entry is None:
+                entry = temps.stage_entries.get(prod.label)
+            if entry is None:
+                entry = {"stage": prod.label, "maps": 0, "buckets": 0,
+                         "rows_in": 0, "bytes_in": 0, "rows_shuffled": 0,
+                         "bytes_shuffled": 0, "regenerated": 0,
+                         "recovered": 0}
+                self._stage_reports.append(entry)
+                temps.stage_entries[prod.label] = entry
+            prod.entry = entry
+            entry["regenerated"] += num_blobs
+            entry["recovered"] += 1
 
     def reset_shuffle_stage_report(self) -> None:
         with self._report_lock:
@@ -249,33 +622,247 @@ class Engine:
             except Exception:
                 logger.warning("failed to free %d shuffle intermediates", len(temps))
 
+    # ---- lineage recovery ---------------------------------------------------
+    @staticmethod
+    def _record_lineage(temps: List[ObjectRef], tasks: Sequence[T.Task],
+                        results: Sequence[Dict[str, Any]], label: str,
+                        task_bytes: Optional[Sequence[bytes]] = None) -> None:
+        """Ledger every intermediate a stage just produced against its
+        serialized producer task: shuffle buckets in bucket order, RETURN_REF
+        blocks as singletons. The recipe (not the data) is what makes a lost
+        blob recoverable on any executor — SURVEY.md's lineage-based fault
+        tolerance, extended from ``cache()`` frames to every intermediate.
+        ``task_bytes`` reuses the dispatch payloads so recording adds no
+        second serialization pass."""
+        if not isinstance(temps, _ActionTemps):
+            return
+        for i, (task, r) in enumerate(zip(tasks, results)):
+            ids = [ref.id for ref in _result_refs(r)]
+            if not ids:
+                continue
+            blob = task_bytes[i] if task_bytes is not None \
+                else cloudpickle.dumps(task)
+            prod = _Producer(blob, ids, label)
+            for oid in ids:
+                temps.lineage[oid] = prod
+
+    def _run_stage(self, tasks: Sequence[T.Task],
+                   preferred: Optional[Sequence[Optional[str]]] = None,
+                   temps: Optional[List[ObjectRef]] = None,
+                   lineage_label: Optional[str] = None,
+                   _depth: int = 0) -> List[Dict[str, Any]]:
+        """``pool.run_tasks`` with lineage recovery: on a lost-blob failure,
+        re-execute the producers of the lost intermediates (transitively,
+        bounded depth), re-home the regenerated blobs, patch the stage's
+        input refs, and resubmit — with exponential backoff + jitter between
+        rounds. ``RDT_LINEAGE_RECOVERY=0`` disables recovery (the loss then
+        surfaces as the ``StageError`` it always was).
+
+        ``lineage_label`` ledgers the stage's own outputs AFTER it succeeds —
+        recorded here, not by the caller, so the recipes carry any ref
+        patches recovery applied (a recipe referencing an already-dead input
+        id would force a pointless transitive round later)."""
+        tasks = list(tasks)
+        results: List[Optional[Dict[str, Any]]] = [None] * len(tasks)
+        rounds = _recovery_rounds() \
+            if _recovery_enabled() and isinstance(temps, _ActionTemps) else 0
+        attempt = 0
+        # one serialization per task, shared by dispatch AND the lineage
+        # ledger; a recovery round invalidates only the entries it patched
+        # (the blobs must match what actually ran / what a rerun would read)
+        blobs: Optional[List[Optional[bytes]]] = \
+            [None] * len(tasks) if lineage_label is not None else None
+        try:
+            while True:
+                todo = [i for i, r in enumerate(results) if r is None]
+                sub_pref = [preferred[i] for i in todo] \
+                    if preferred is not None else None
+                if blobs is not None:
+                    for i, t in enumerate(tasks):
+                        if blobs[i] is None:
+                            blobs[i] = cloudpickle.dumps(t)
+                try:
+                    out = self.pool.run_tasks(
+                        [tasks[i] for i in todo], sub_pref,
+                        payloads=[blobs[i] for i in todo]
+                        if blobs is not None else None)
+                    for i, r in zip(todo, out):
+                        results[i] = r
+                    if lineage_label is not None:
+                        self._record_lineage(temps, tasks, results,
+                                             lineage_label, task_bytes=blobs)
+                    return results
+                except ObjectsLostError as e:
+                    if e.partial is not None:
+                        # keep this round's completed work; only the
+                        # unfinished tasks resubmit after recovery
+                        for i, r in zip(todo, e.partial):
+                            if r is not None:
+                                results[i] = r
+                    if attempt >= rounds or not e.lost_ids:
+                        raise
+                    lost = self._expand_lost(e.lost_ids, tasks, results,
+                                             temps)
+                    mapping = self._regenerate(sorted(lost), temps, _depth)
+                    if mapping is None:
+                        raise
+                    patched = [T.patch_task_refs(t, mapping) for t in tasks]
+                    if blobs is not None:
+                        for i, (old, new) in enumerate(zip(tasks, patched)):
+                            if new is not old:
+                                blobs[i] = None
+                    tasks = patched
+                    delay = _backoff_delay(attempt + 1, self._retry_rng,
+                                           base=0.1)
+                    logger.warning(
+                        "resubmitting %d/%d stage tasks after lineage "
+                        "recovery of %d blobs (round %d, backoff %.2fs)",
+                        sum(1 for r in results if r is None), len(tasks),
+                        len(lost), attempt + 1, delay)
+                    time.sleep(delay)
+                    attempt += 1
+        except Exception:
+            # outputs completed in earlier rounds never reach the caller on a
+            # raise: free them (the pool already freed its own sub-round's)
+            _free_result_refs(results)
+            raise
+
+    @staticmethod
+    def _expand_lost(lost_ids: Sequence[str], tasks: Sequence[T.Task],
+                     results: Sequence[Optional[Dict[str, Any]]],
+                     temps: "_ActionTemps") -> set:
+        """Widen a consumer-reported loss to everything one locations() probe
+        says is equally gone, sharing the read path's loss criterion. A
+        consumer reports only the FIRST missing blob it read, so without
+        this a host death taking several producers' outputs recovers one
+        producer per round until the rounds budget burns. Two signals:
+        ledgered inputs of unfinished tasks absent from the store table
+        (freed or already purged), and — because a dead payload host's table
+        entries outlive it until purge_host runs — every ledgered candidate
+        homed on a host that still "lists" a blob whose read just failed.
+        Head-local losses stay blob-specific (a missing spill file says
+        nothing about its neighbors). Best-effort: on probe failure the
+        per-round discovery still converges, just more slowly."""
+        lost = set(lost_ids)
+        try:
+            cand = {cid: ObjectRef(id=cid)
+                    for i, r in enumerate(results) if r is None
+                    for cid in T.task_input_ids(tasks[i])
+                    if cid in temps.lineage}
+            if not cand:
+                return lost
+            probe = list(cand.values()) + [
+                ObjectRef(id=lid) for lid in lost if lid not in cand]
+            locs = get_client().locations(probe)
+            lost.update(c for c in cand if c not in locs)
+            dead_hosts = {locs[lid] for lid in lost_ids
+                          if lid in locs} - {HEAD_HOST}
+            if dead_hosts:
+                lost.update(c for c in cand if locs.get(c) in dead_hosts)
+        except Exception:
+            pass
+        return lost
+
+    def _regenerate(self, lost_ids: Sequence[str], temps: "_ActionTemps",
+                    depth: int) -> Optional[Dict[str, ObjectRef]]:
+        """Re-execute the producer task of every lost intermediate; return
+        old-id → fresh-ref patches for ALL the producers' outputs (reruns are
+        deterministic, so sibling buckets are identical — patching them too
+        costs nothing and spares bookkeeping). None = unrecoverable (no
+        lineage for a source blob, or the transitive depth budget burned)."""
+        if depth >= _recovery_depth():
+            logger.warning("lineage recovery depth %d exhausted", depth)
+            return None
+        groups: Dict[int, Tuple[_Producer, List[str]]] = {}
+        for oid in set(lost_ids):
+            prod = temps.lineage.get(oid)
+            if prod is None:
+                logger.warning("no lineage recorded for lost object %s; "
+                               "cannot recover", oid)
+                return None
+            groups.setdefault(id(prod), (prod, []))[1].append(oid)
+        # one batched rerun per producer LABEL (one loss usually takes a
+        # whole stage's worth of producers — _expand_lost harvests them all,
+        # and serial single-task stages would leave the pool idle for
+        # N × single-task latency instead of ceil(N / pool))
+        by_label: Dict[str, List[Tuple[_Producer, List[str]]]] = {}
+        for prod, ids in groups.values():
+            by_label.setdefault(prod.label, []).append((prod, ids))
+        mapping: Dict[str, ObjectRef] = {}
+        for label, plist in by_label.items():
+            rerun = [cloudpickle.loads(p.task_bytes) for p, _ in plist]
+            with profiler.trace("recover:lineage", "etl", stage=label,
+                                lost=sum(len(ids) for _, ids in plist),
+                                producers=len(plist)):
+                # nested losses (the producers' own inputs) recover through
+                # the same machinery, one depth level down; the rerun also
+                # re-ledgers its outputs (with any nested ref patches)
+                res_list = self._run_stage(rerun, None, temps,
+                                           lineage_label=label,
+                                           _depth=depth + 1)
+            for (prod, ids), res in zip(plist, res_list):
+                # same extraction the ledger used, so outputs zip 1:1
+                new_refs = _result_refs(res)
+                temps.extend(new_refs)
+                if len(new_refs) != len(prod.outputs):
+                    logger.warning(
+                        "regenerated producer emitted %d outputs, expected "
+                        "%d; aborting recovery", len(new_refs),
+                        len(prod.outputs))
+                    return None
+                sub = dict(zip(prod.outputs, new_refs))
+                mapping.update(sub)
+                temps.apply_patches(sub)
+                self._note_recovery(prod, len(ids), temps)
+                # the rerun re-ledgered fresh _Producer objects for its
+                # outputs; inherit the stage binding so a SECOND loss of a
+                # regenerated blob still attributes to the original entry
+                for ref in new_refs:
+                    nprod = temps.lineage.get(ref.id)
+                    if nprod is not None and nprod.entry is None:
+                        nprod.entry = prod.entry
+                logger.warning(
+                    "lineage recovery: regenerated %d lost blob(s) (of %d "
+                    "outputs) for stage %r", len(ids), len(prod.outputs),
+                    label)
+        return mapping
+
     # ---- public entry points ------------------------------------------------
     def materialize(self, node: P.PlanNode, owner: Optional[str] = None
                     ) -> Tuple[List[ObjectRef], Optional[bytes], List[int]]:
         """Execute the plan; return per-partition (refs, schema bytes, row counts)."""
-        temps: List[ObjectRef] = []
+        temps = _ActionTemps()
         try:
-            return self._materialize_inner(self._optimized(node), owner, temps)
+            # the returned refs are the action's FINAL outputs: nothing later
+            # in this action can lose them, so ledgering their recipes would
+            # be pure serialization overhead on the data-feed hot path
+            return self._materialize_inner(self._optimized(node), owner,
+                                           temps, lineage_label=None)
         finally:
             self._free(temps)
 
     def _materialize_inner(self, node: P.PlanNode, owner: Optional[str],
-                           temps: List[ObjectRef]):
+                           temps: List[ObjectRef],
+                           lineage_label: Optional[str] = "materialize"):
+        """``lineage_label`` defaults on: the internal callers (sort child,
+        window input, coalesce) feed these refs to LATER stages of the same
+        action, which is exactly when a lost blob needs the recipe."""
         tasks, preferred = self._compile(node, temps)
         tasks = [t.with_output(output=T.RETURN_REF, owner=owner or self.owner)
                  for t in tasks]
-        results = self.pool.run_tasks(tasks, preferred)
+        results = self._run_stage(tasks, preferred, temps,
+                                  lineage_label=lineage_label)
         refs = [r["ref"] for r in results]
         schema = results[0]["schema"] if results else None
         num_rows = [r["num_rows"] for r in results]
         return refs, schema, num_rows
 
     def collect(self, node: P.PlanNode) -> pa.Table:
-        temps: List[ObjectRef] = []
+        temps = _ActionTemps()
         try:
             tasks, preferred = self._compile(self._optimized(node), temps)
             tasks = [t.with_output(output=T.COLLECT) for t in tasks]
-            results = self.pool.run_tasks(tasks, preferred)
+            results = self._run_stage(tasks, preferred, temps)
             tables = [pa.ipc.open_stream(pa.py_buffer(r["ipc"])).read_all()
                       for r in results]
             out = pa.concat_tables(tables, promote_options="permissive")
@@ -285,11 +872,11 @@ class Engine:
             self._free(temps)
 
     def count(self, node: P.PlanNode) -> int:
-        temps: List[ObjectRef] = []
+        temps = _ActionTemps()
         try:
             tasks, preferred = self._compile(self._optimized(node), temps)
             tasks = [t.with_output(output=T.ROWCOUNT) for t in tasks]
-            results = self.pool.run_tasks(tasks, preferred)
+            results = self._run_stage(tasks, preferred, temps)
             total = sum(r["num_rows"] for r in results)
             limit = _root_limit(node)
             return min(total, limit) if limit is not None else total
@@ -307,26 +894,46 @@ class Engine:
         them — they are released with the frame (the GC-pin of
         ObjectStoreWriter.scala:175-177).
         """
-        temps: List[ObjectRef] = []
+        temps = _ActionTemps()
         try:
             tasks, preferred = self._compile(self._optimized(node), temps)
-            cache_tasks, recover_blobs, keys = [], [], []
+            cache_tasks, keys = [], []
             for i, t in enumerate(tasks):
                 key = f"block_{frame_id}_{i}"
-                recover = t.with_output(output=T.RETURN_REF)
-                recover_blobs.append(cloudpickle.dumps(recover))
                 keys.append(key)
                 cache_tasks.append(t.with_output(output=T.CACHE, cache_key=key))
-            results = self.pool.run_tasks(cache_tasks, preferred)
+            results = self._run_stage(cache_tasks, preferred, temps)
+            # recover recipes are serialized AFTER the stage so they carry
+            # any ref patches in-stage lineage recovery applied — a recipe
+            # pointing at a pre-recovery (dead) blob id would fail every
+            # future cache miss
+            recover_blobs = [
+                cloudpickle.dumps(T.patch_task_refs(
+                    t.with_output(output=T.RETURN_REF), temps.ref_patches))
+                for t in tasks
+            ]
         except BaseException:
             self._free(temps)
+            # partitions that completed before the failure already stored
+            # their tables in executor block caches, beyond the reach of the
+            # store-only free above — drop them by prefix everywhere, or
+            # every retried persist of a failing plan pins more partition
+            # tables in unbounded executor RAM. A straggler abandoned past
+            # the drain timeout can still cache AFTER this sweep: the
+            # pool's _free_late_result drops that block when it lands
+            for h in self.pool.executors:
+                try:
+                    h.drop_block_prefix(f"block_{frame_id}_")
+                except Exception:
+                    pass
             raise
         executors = [r["executor"] for r in results]
         schema = results[0]["schema"] if results else None
-        # temps stay pinned: the lineage recipes reference them
+        # temps stay pinned: the lineage recipes reference them (plain list —
+        # the per-action ledger has no meaning past this action)
         return P.CachedScan(frame_id=frame_id, cache_keys=keys,
                             executors=executors, recover_tasks=recover_blobs,
-                            schema=schema, pinned_refs=temps)
+                            schema=schema, pinned_refs=list(temps))
 
     def random_shuffle_refs(self, refs: Sequence[ObjectRef],
                             schema_bytes: Optional[bytes],
@@ -343,7 +950,7 @@ class Engine:
         random_shuffle at torch/estimator.py:335-338). Returns (refs, rows)
         per output block; intermediates are freed before returning.
         """
-        temps: List[ObjectRef] = []
+        temps = _ActionTemps()
         try:
             nb = max(1, len(refs))
             base = 0 if seed is None else int(seed)
@@ -354,9 +961,10 @@ class Engine:
                              owner=self.owner)
                 for i, r in enumerate(refs)
             ]
-            results = self.pool.run_tasks(
-                map_tasks, self._locality([[r] for r in refs]))
-            self._record_stage("random-shuffle", results, nb)
+            results = self._run_stage(
+                map_tasks, self._locality([[r] for r in refs]), temps,
+                lineage_label="random-shuffle")
+            self._record_stage("random-shuffle", results, nb, temps)
             buckets = self._gather_buckets(results, nb, temps)
             reduce_tasks = [
                 self._task(T.ArrowRefSource(bucket, schema=schema_bytes),
@@ -365,13 +973,13 @@ class Engine:
                 .with_output(output=T.RETURN_REF, owner=owner or self.owner)
                 for b, bucket in enumerate(buckets)
             ]
-            out = self.pool.run_tasks(reduce_tasks, self._locality(buckets))
+            out = self._run_stage(reduce_tasks, self._locality(buckets), temps)
             return [r["ref"] for r in out], [r["num_rows"] for r in out]
         finally:
             self._free(temps)
 
     def num_partitions(self, node: P.PlanNode) -> int:
-        temps: List[ObjectRef] = []
+        temps = _ActionTemps()
         try:
             tasks, _ = self._compile(self._optimized(node), temps)
             return len(tasks)
@@ -560,8 +1168,8 @@ class Engine:
                                shuffle_keys=keys, range_key=range_key,
                                owner=self.owner)
                  for t in tasks]
-        results = self.pool.run_tasks(tasks, preferred)
-        self._record_stage(label, results, num_buckets)
+        results = self._run_stage(tasks, preferred, temps, lineage_label=label)
+        self._record_stage(label, results, num_buckets, temps)
         schema = results[0]["schema"] if results else None
         return self._gather_buckets(results, num_buckets, temps), schema
 
@@ -652,7 +1260,7 @@ class Engine:
         ]
         sampled = []
         if sample_tasks:
-            for r in self.pool.run_tasks(sample_tasks):
+            for r in self._run_stage(sample_tasks, None, temps):
                 tbl = pa.ipc.open_stream(pa.py_buffer(r["ipc"])).read_all()
                 if tbl.num_rows:
                     sampled.append(tbl)
@@ -685,8 +1293,9 @@ class Engine:
                 owner=self.owner)
             for ref in refs
         ]
-        results = self.pool.run_tasks(shuffle_tasks)
-        self._record_stage("sort-range", results, len(boundaries) + 1)
+        results = self._run_stage(shuffle_tasks, None, temps,
+                                  lineage_label="sort-range")
+        self._record_stage("sort-range", results, len(boundaries) + 1, temps)
         buckets = self._gather_buckets(results, len(boundaries) + 1, temps)
         # buckets come out in global sort order for any direction mix (the
         # composite comparison honors per-key direction; nulls sort last)
@@ -754,7 +1363,7 @@ class Engine:
         partition to one row of moment partials (DescribeStep); the driver
         merges K tiny rows, never the data. Sample stddev (ddof=1), matching
         Spark's ``describe``."""
-        temps: List[ObjectRef] = []
+        temps = _ActionTemps()
         try:
             # describe reads only `cols`: expose that to the optimizer by
             # narrowing the plan root, so scans and shuffles below prune too
@@ -764,7 +1373,7 @@ class Engine:
             tasks = [t.with_output(steps=t.steps + [T.DescribeStep(cols)],
                                    output=T.COLLECT)
                      for t in tasks]
-            results = self.pool.run_tasks(tasks, preferred)
+            results = self._run_stage(tasks, preferred, temps)
         finally:
             self._free(temps)
         agg = {c: {"count": 0, "sum": 0.0, "sumsq": 0.0,

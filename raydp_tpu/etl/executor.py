@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional
 import cloudpickle
 import pyarrow as pa
 
+from raydp_tpu import faults
 from raydp_tpu.etl import tasks as T
 from raydp_tpu.log import get_logger
 from raydp_tpu.runtime.actor import current_actor_context
@@ -33,20 +34,30 @@ class BlockCache:
     def __init__(self):
         self._lock = threading.Lock()
         self._blocks: Dict[str, pa.Table] = {}
+        #: per-put generation stamp — a drop conditioned on a stamp only
+        #: removes the exact entry its caller saw, so a drain-abandoned
+        #: straggler's deferred cleanup can't delete the live block a
+        #: recovery resubmit of the same task cached under the same key
+        self._stamps: Dict[str, Optional[str]] = {}
 
     def get(self, key: str) -> Optional[pa.Table]:
         with self._lock:
             return self._blocks.get(key)
 
-    def put(self, key: str, table: pa.Table) -> None:
+    def put(self, key: str, table: pa.Table,
+            stamp: Optional[str] = None) -> None:
         with self._lock:
             self._blocks[key] = table
+            self._stamps[key] = stamp
 
-    def drop(self, keys: List[str]) -> int:
+    def drop(self, keys: List[str], if_stamp: Optional[str] = None) -> int:
         with self._lock:
             n = 0
             for k in keys:
+                if if_stamp is not None and self._stamps.get(k) != if_stamp:
+                    continue
                 if self._blocks.pop(k, None) is not None:
+                    self._stamps.pop(k, None)
                     n += 1
             return n
 
@@ -55,6 +66,7 @@ class BlockCache:
             victims = [k for k in self._blocks if k.startswith(prefix)]
             for k in victims:
                 del self._blocks[k]
+                self._stamps.pop(k, None)
             return len(victims)
 
     def keys(self) -> List[str]:
@@ -105,9 +117,10 @@ class EtlExecutor:
         return "pong"
 
     def crash(self) -> None:
-        """Fault injection: die abruptly (tests' node-kill analogue)."""
-        import os
-        os._exit(23)
+        """Fault injection: die abruptly (tests' node-kill analogue). The
+        declarative twin is an ``executor.run_task:crash`` rule in
+        ``RDT_FAULTS`` (see raydp_tpu/faults.py)."""
+        faults.crash_process()
 
     def get_executor_id(self) -> Optional[str]:
         return self.executor_id
@@ -118,6 +131,9 @@ class EtlExecutor:
         from raydp_tpu import profiler
 
         task: T.Task = cloudpickle.loads(task_bytes)
+        rule = faults.check("executor.run_task", key=task.task_id)
+        if rule is not None:
+            faults.apply(rule, "executor.run_task")
         pre = (int(getattr(task, "shuffle_pre_steps", 0) or 0)
                if task.output == T.SHUFFLE else 0)
         rows_in = bytes_in = None
@@ -150,11 +166,13 @@ class EtlExecutor:
 
         if task.output == T.CACHE:
             assert task.cache_key is not None
-            self.cache.put(task.cache_key, table)
+            stamp = uuid.uuid4().hex
+            self.cache.put(task.cache_key, table, stamp)
             return {
                 "num_rows": table.num_rows,
                 "nbytes": table.nbytes,
                 "cache_key": task.cache_key,
+                "cache_stamp": stamp,
                 "executor": self._actor_name,
                 "schema": table.schema.serialize().to_pybytes(),
             }
@@ -182,6 +200,34 @@ class EtlExecutor:
                     buckets = T.round_robin_buckets(table, task.num_buckets,
                                                     start)
             refs = [client.put_arrow(b, owner=owner) for b in buckets]
+            rule = faults.check("shuffle.write", key=task.task_id)
+            if rule is not None:
+                if rule.action == "drop" and refs:
+                    # the blob is written, its ref handed to the driver — and
+                    # the payload silently dies before the reduce stage reads
+                    # it (the store-host-died model the lineage ledger
+                    # exists for)
+                    victim = refs[rule.bucket % len(refs)]
+                    try:
+                        client.free([victim])
+                    except Exception:
+                        pass
+                    logger.warning("fault plane dropped shuffle bucket %s "
+                                   "of %s", victim.id, task.task_id)
+                else:
+                    # a fired rule must never be swallowed (its once-sentinel
+                    # is already claimed): generic actions apply here too. An
+                    # injected raise fails the task AFTER its buckets hit the
+                    # store — free them first, or the retry's fresh copies
+                    # leave these orphaned until session shutdown (crash is
+                    # deliberately not cleaned up: an abruptly dead process
+                    # leaves its writes behind, which is the point)
+                    if rule.action == "raise" and refs:
+                        try:
+                            client.free(refs)
+                        except Exception:
+                            pass
+                    faults.apply(rule, "shuffle.write")
             # ref.size is the serialized payload written to the store — the
             # honest bytes-moved number (bucket tables are zero-copy slices,
             # whose nbytes would overcount shared buffers)
@@ -239,8 +285,9 @@ class EtlExecutor:
     def list_blocks(self) -> List[str]:
         return self.cache.keys()
 
-    def drop_blocks(self, keys: List[str]) -> int:
-        return self.cache.drop(keys)
+    def drop_blocks(self, keys: List[str],
+                    if_stamp: Optional[str] = None) -> int:
+        return self.cache.drop(keys, if_stamp)
 
     def drop_block_prefix(self, prefix: str) -> int:
         return self.cache.drop_prefix(prefix)

@@ -685,6 +685,62 @@ def run_task_body(task: Task) -> pa.Table:
     return table
 
 
+# ==== lineage-recovery ref surgery =================================================
+def task_input_ids(task: Task) -> List[str]:
+    """Object ids a task reads — the refs lineage recovery must keep alive
+    (or regenerate) for the task to run."""
+    ids: List[str] = []
+
+    def _step(step: Step) -> None:
+        if isinstance(step, ArrowRefSource):
+            ids.extend(r.id for r in step.refs)
+        elif isinstance(step, SlicedRefSource):
+            ids.extend(r.id for r, _, _ in step.parts)
+        elif isinstance(step, HashJoinStep):
+            ids.extend(r.id for r in step.right_refs)
+        elif isinstance(step, CachedSource) and step.recover is not None:
+            ids.extend(task_input_ids(step.recover))
+
+    _step(task.source)
+    for s in task.steps:
+        _step(s)
+    return ids
+
+
+def _patch_step_refs(step: Step, mapping: Dict[str, ObjectRef]) -> Step:
+    import dataclasses
+    if isinstance(step, ArrowRefSource):
+        refs = [mapping.get(r.id, r) for r in step.refs]
+        if refs != step.refs:
+            return dataclasses.replace(step, refs=refs)
+    elif isinstance(step, SlicedRefSource):
+        parts = [(mapping.get(r.id, r), o, n) for r, o, n in step.parts]
+        if parts != step.parts:
+            return dataclasses.replace(step, parts=parts)
+    elif isinstance(step, HashJoinStep):
+        refs = [mapping.get(r.id, r) for r in step.right_refs]
+        if refs != step.right_refs:
+            return dataclasses.replace(step, right_refs=refs)
+    elif isinstance(step, CachedSource) and step.recover is not None:
+        recover = patch_task_refs(step.recover, mapping)
+        if recover is not step.recover:
+            return dataclasses.replace(step, recover=recover)
+    return step
+
+
+def patch_task_refs(task: Task, mapping: Dict[str, ObjectRef]) -> Task:
+    """Rewrite a task to read regenerated blobs: every ObjectRef whose id is
+    in ``mapping`` (old id → fresh ref) is swapped, everywhere a task can hold
+    refs. Returns the original task object when nothing matched."""
+    if not mapping:
+        return task
+    source = _patch_step_refs(task.source, mapping)
+    steps = [_patch_step_refs(s, mapping) for s in task.steps]
+    if source is task.source and all(a is b for a, b in zip(steps, task.steps)):
+        return task
+    return task.with_output(source=source, steps=steps)
+
+
 def split_by_bucket(table: pa.Table, bucket: np.ndarray,
                     num_buckets: int) -> List[pa.Table]:
     """One-pass bucket split: a single stable argsort + ``take`` + zero-copy

@@ -42,9 +42,33 @@ from typing import Any, Dict, List, Optional, Tuple
 import cloudpickle
 import pyarrow as pa
 
+from raydp_tpu import faults
 from raydp_tpu.log import get_logger
 
 logger = get_logger("object_store")
+
+
+class ObjectLostError(KeyError):
+    """A store blob is gone or unreachable: the table has no entry (freed,
+    owner died, host purged) or the payload plane cannot serve it. Typed so
+    the ETL engine can tell this apart from deterministic application errors
+    (which fail fast) and route it into lineage recovery — retrying the
+    consumer task would just replay the miss until the retry budget burns.
+
+    Carried across processes as ``RemoteError.exc_type == "ObjectLostError"``
+    with the 32-hex object id embedded in the message, which is how the
+    driver learns *which* blob to regenerate."""
+
+    def __init__(self, object_id: str, detail: str = ""):
+        msg = f"object {object_id} lost from store"
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+        self.object_id = object_id
+
+    # not KeyError.__str__: loss messages must not render repr-quoted in
+    # logs, RemoteError.message, and ObjectsLostError text
+    __str__ = Exception.__str__
 
 KIND_RAW = "raw"
 KIND_PICKLE = "pickle"
@@ -877,12 +901,52 @@ class ObjectStoreClient:
 
     # -- read -----------------------------------------------------------------
     def _attach(self, object_id: str) -> Tuple[memoryview, str]:
+        rule = faults.check("store.get", key=object_id)
+        if rule is not None:
+            if rule.action == "drop":
+                # genuinely remove the blob (the store-host-died model), so
+                # every later reader misses too — recovery must regenerate,
+                # not merely retry
+                try:
+                    self._server.free([object_id])
+                except Exception:
+                    pass
+                raise ObjectLostError(object_id, "fault-injected drop")
+            faults.apply(rule, "store.get")
         try:
-            return self._attach_once(object_id)
-        except FileNotFoundError:
-            # the payload moved (spill eviction recycled the segment between
-            # our lookup and attach): one fresh lookup resolves the new home
-            return self._attach_once(object_id)
+            try:
+                return self._attach_once(object_id)
+            except FileNotFoundError:
+                # the payload moved (spill eviction recycled the segment
+                # between our lookup and attach): one fresh lookup resolves
+                # the new home
+                return self._attach_once(object_id)
+            except Exception as e:
+                # the same lookup/attach race through an RPC proxy: the
+                # server's FileNotFoundError arrives as a RemoteError, so it
+                # gets the same single fresh-lookup retry — a still-alive
+                # blob must not be escalated to "lost" (which bypasses task
+                # retry and re-executes its producer)
+                if getattr(e, "exc_type", None) == "FileNotFoundError":
+                    return self._attach_once(object_id)
+                raise
+        except ObjectLostError:
+            raise
+        except KeyError as e:
+            # table lookup miss (head in-process) — the blob is gone
+            raise ObjectLostError(object_id, "not in store table") from e
+        except FileNotFoundError as e:
+            raise ObjectLostError(object_id, f"segment vanished: {e}") from e
+        except Exception as e:
+            # lookup/fetch through an RPC proxy surfaces the server's
+            # KeyError (table miss) or FileNotFoundError (segment vanished on
+            # the payload host) as a RemoteError; duck-type on exc_type to
+            # avoid importing rpc
+            if getattr(e, "exc_type", None) in (
+                    "KeyError", "ObjectLostError", "FileNotFoundError"):
+                raise ObjectLostError(object_id, "blob unreachable: "
+                                      f"{getattr(e, 'message', e)}") from e
+            raise
 
     def _attach_once(self, object_id: str) -> Tuple[memoryview, str]:
         if self.remote:
@@ -895,10 +959,30 @@ class ObjectStoreClient:
             # node's payload server (never through the head — parity with
             # plasma's node-to-node object transfer)
             if payload_addr:
-                # bounded: a wedged-but-connected owner must fail the read
-                # into task retry / lineage recovery, not hang it
-                data = self._peer(payload_addr).call(
-                    "store_fetch", segment, offset, size, timeout=60.0)
+                import concurrent.futures as _cf
+                try:
+                    # bounded: a wedged-but-connected owner must fail the
+                    # read into task retry / lineage recovery, not hang it
+                    data = self._peer(payload_addr).call(
+                        "store_fetch", segment, offset, size, timeout=60.0)
+                except (OSError, _cf.TimeoutError, TimeoutError) as e:
+                    # the store host died/wedged with the table entry still
+                    # present (purge_host lags the death): this IS the
+                    # lost-blob case — surface the typed signal so lineage
+                    # recovery regenerates instead of the consumer burning
+                    # its retry budget against a dead host. ConnectionLost
+                    # subclasses RpcError, not OSError — duck-type it.
+                    raise ObjectLostError(
+                        object_id,
+                        f"payload host {payload_addr} unreachable: {e}") \
+                        from e
+                except Exception as e:
+                    if type(e).__name__ == "ConnectionLost":
+                        raise ObjectLostError(
+                            object_id,
+                            f"payload host {payload_addr} unreachable: {e}") \
+                            from e
+                    raise
             else:  # owner is the head machine; the table server serves it
                 data, kind = self._server.fetch_payload(object_id)
             return memoryview(data), kind

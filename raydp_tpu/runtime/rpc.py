@@ -25,6 +25,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import cloudpickle
 
+from raydp_tpu import faults
+
 logger = logging.getLogger("raydp_tpu.rpc")
 
 _LEN = struct.Struct(">Q")
@@ -40,16 +42,23 @@ class ConnectionLost(RpcError):
 
 
 class RemoteError(RpcError):
-    """An exception raised inside the remote handler, with its traceback."""
+    """An exception raised inside the remote handler, with its traceback.
 
-    def __init__(self, exc_type: str, message: str, remote_traceback: str):
+    ``object_id`` rides along when the remote exception carried one (e.g.
+    ``ObjectLostError``), so consumers key recovery on a structured field
+    instead of parsing ids out of message text."""
+
+    def __init__(self, exc_type: str, message: str, remote_traceback: str,
+                 object_id: Optional[str] = None):
         super().__init__(f"{exc_type}: {message}\n--- remote traceback ---\n{remote_traceback}")
         self.exc_type = exc_type
         self.message = message
         self.remote_traceback = remote_traceback
+        self.object_id = object_id
 
     def __reduce__(self):
-        return (RemoteError, (self.exc_type, self.message, self.remote_traceback))
+        return (RemoteError, (self.exc_type, self.message,
+                              self.remote_traceback, self.object_id))
 
 
 def _send_frame(sock: socket.socket, payload: bytes, lock: threading.Lock) -> None:
@@ -194,13 +203,17 @@ class RpcServer:
 
     @staticmethod
     def _error_payload(req_id, e) -> bytes:
-        err = RemoteError(type(e).__name__, str(e), traceback.format_exc())
+        oid = getattr(e, "object_id", None)
+        oid = oid if isinstance(oid, str) else None
+        err = RemoteError(type(e).__name__, str(e), traceback.format_exc(),
+                          object_id=oid)
         try:
             return cloudpickle.dumps((req_id, False, err))
         except Exception:
             return cloudpickle.dumps(
                 (req_id, False,
-                 RemoteError(type(e).__name__, str(e), "<unpicklable>")))
+                 RemoteError(type(e).__name__, str(e), "<unpicklable>",
+                             object_id=oid)))
 
     def stop(self) -> None:
         self._stopped.set()
@@ -252,6 +265,13 @@ class RpcClient:
                 fut.set_exception(exc)
 
     def submit(self, method: str, *args, **kwargs) -> Future:
+        rule = faults.check("rpc.call", key=method)
+        if rule is not None:
+            if rule.action == "connloss":
+                raise ConnectionLost(
+                    f"injected connection loss to {self.address} "
+                    f"on {method!r}")
+            faults.apply(rule, "rpc.call")
         if self._closed:
             raise ConnectionLost(f"connection to {self.address} closed")
         fut: Future = Future()

@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from raydp_tpu import faults
 from raydp_tpu.log import get_logger
 from raydp_tpu.train.estimator import (
     EstimatorInterface,
@@ -460,6 +461,9 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
         while epoch < self.num_epochs:
             try:
+                rule = faults.check("estimator.epoch", key=str(epoch))
+                if rule is not None:  # chaos tests provoke the retry path here
+                    faults.apply(rule, "estimator.epoch")
                 t0 = time.perf_counter()
                 mstats = tuple(m.init() for m in metrics)
                 loss_sum = np.zeros((), np.float32)

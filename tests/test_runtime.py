@@ -203,6 +203,59 @@ def test_cluster_resources_satisfy(runtime_3nodes):
     assert all(lbl.startswith("node:") for lbl in labels)
 
 
+def test_driver_heartbeat_reap_sweeps_actors_and_objects(runtime):
+    """A driver that stops heartbeating without detaching is reaped: its
+    still-bound actors die AND the objects those actors own are swept from
+    the store; a driver re-attaching under the same id afterwards is a fresh
+    registration (heartbeats accepted, new actors reapable). In-process twin
+    of the subprocess test in test_attach.py, covering the object sweep."""
+    import uuid
+
+    from raydp_tpu.runtime.actor import ActorSpec, dump_spec
+
+    rt = runtime
+    rt.driver_reap_after_s = 3600.0  # wide during setup; shrunk below
+    rt.register_driver("hb-driver")
+    assert rt.driver_heartbeat("hb-driver") is True
+
+    cls_bytes, args_bytes = dump_spec(Counter, (3,), {})
+    spec = ActorSpec(actor_id=f"actor-{uuid.uuid4().hex[:12]}",
+                     name="hb-actor", cls_bytes=cls_bytes,
+                     args_bytes=args_bytes, resources={"CPU": 1.0},
+                     max_restarts=-1)
+    h = rt.launch_actor(spec, block=True, driver_id="hb-driver")
+    ref = h.put_table(25)  # owned by the actor ("hb-actor")
+    assert rt.store_client.contains(ref)
+
+    # stop heartbeating: shrink the window so the last beat lapses — the
+    # supervisor kills the actor (deliberate, no restart despite
+    # max_restarts=-1) and the DEAD transition frees the objects it owned
+    assert rt.driver_heartbeat("hb-driver") is True  # last beat
+    rt.driver_reap_after_s = 1.0
+    deadline = time.time() + 30
+    while time.time() < deadline and h.state() != "DEAD":
+        time.sleep(0.1)
+    assert h.state() == "DEAD", "reap never killed the driver's actor"
+    deadline = time.time() + 10
+    while time.time() < deadline and rt.store_client.contains(ref):
+        time.sleep(0.1)
+    assert not rt.store_client.contains(ref), \
+        "dead driver's actor-owned object leaked"
+    # a lapsed driver's beats are rejected (it must re-attach)...
+    assert rt.driver_heartbeat("hb-driver") is False
+
+    # ...and re-attaching with the SAME id is a clean fresh registration
+    rt.driver_reap_after_s = 3600.0  # back to a sane window for the re-attach
+    rt.register_driver("hb-driver")
+    assert rt.driver_heartbeat("hb-driver") is True
+    spec2 = ActorSpec(actor_id=f"actor-{uuid.uuid4().hex[:12]}",
+                      name="hb-actor-2", cls_bytes=cls_bytes,
+                      args_bytes=args_bytes, resources={"CPU": 1.0})
+    h2 = rt.launch_actor(spec2, block=True, driver_id="hb-driver")
+    assert h2.call("get") == 3
+    rt.detach_driver("hb-driver")
+
+
 class SlowInit:
     """Actor whose __init__ stalls: its ready event fires only after SLEEP_S."""
     SLEEP_S = 8.0

@@ -101,6 +101,55 @@ def test_ranks_share_object_store(runtime):
         job.stop()
 
 
+def test_stop_escalation_sigkills_straggler_and_job_restarts():
+    """Gang teardown robustness (parity: the reference's test_mpi restart
+    case, mpi_job.py:344-395): (1) a rank SIGKILLed mid-life must not wedge
+    ``stop()`` or the next ``start()``; (2) a rank that ignores the stop RPC
+    (simulated with SIGSTOP) is SIGKILLed by the 5s escalation poll; (3) the
+    same job object runs a full start→run→stop cycle after each."""
+    import signal
+    import time
+
+    job = create_spmd_job("t-killrank", world_size=2, timeout=60)
+
+    # cycle 1: kill a rank outright, then stop + restart
+    job.start()
+    try:
+        assert job.run(lambda ctx: ctx.rank) == [0, 1]
+        victim = job._procs[0]
+        os.killpg(victim.pid, signal.SIGKILL)
+        deadline = time.time() + 10
+        while victim.poll() is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert victim.poll() is not None
+    finally:
+        job.stop()
+
+    # cycle 2: restart works after rank death; then wedge a rank so the stop
+    # RPC is never processed — the escalation must SIGKILL it within ~5s
+    job.start()
+    try:
+        assert job.run(lambda ctx: ctx.rank * 2) == [0, 2]
+        straggler = job._procs[1]
+        os.kill(straggler.pid, signal.SIGSTOP)
+    finally:
+        t0 = time.time()
+        job.stop()
+        elapsed = time.time() - t0
+    assert elapsed < 30, f"stop() took {elapsed:.1f}s against a straggler"
+    deadline = time.time() + 10
+    while straggler.poll() is None and time.time() < deadline:
+        time.sleep(0.05)
+    assert straggler.poll() is not None, "straggler survived stop()"
+
+    # cycle 3: the object still restarts cleanly after the escalated stop
+    job.start()
+    try:
+        assert job.run(lambda ctx: ctx.job_id) == ["t-killrank"] * 2
+    finally:
+        job.stop()
+
+
 def test_jax_distributed_gang():
     """world=2 ranks form one jax.distributed mesh; a psum across the global
     device set returns the world sum on every rank — the XLA-collective
