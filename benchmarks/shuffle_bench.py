@@ -16,6 +16,17 @@ and records per-config:
 - ``identical`` — the two paths' results compared row-for-row after a
   canonical sort (integer payloads, so aggregates are exact).
 
+A second leg measures the CONTROL plane: the ``repartition_many`` config
+shuffles a many-partition frame (64 maps x 64 buckets of small rows; the
+small-object regime where per-object fixed costs dominate) with
+``RDT_SHUFFLE_CONSOLIDATE`` off and on, recording per mode:
+
+- ``store_rpcs_*`` — store table/payload control operations from the head
+  server's op counters (a ``seal_batch``/``lookup_batch`` counts ONE op),
+- ``wall_*_s`` and ``bytes_*``,
+- ``rpc_reduction_x`` — store_rpcs_naive / store_rpcs_consolidated,
+- ``identical`` — results row-for-row equal after a canonical sort.
+
 The record lands in ``benchmarks/SHUFFLE_BYTES.json`` (override:
 ``RDT_SHUFFLE_BYTES_PATH``). ``--smoke`` shrinks the data to seconds of
 wall and writes to /tmp by default so a CI smoke run cannot clobber the
@@ -67,6 +78,46 @@ def run_config(session, action, sort_keys):
     out["identical"] = tables["naive"].equals(tables["opt"])
     out["stages_opt"] = [r["stage"] for r in
                          session.engine.shuffle_stage_report()]
+    return out
+
+
+#: store control-plane ops that make up the "store RPCs" number (op names
+#: from ObjectStoreServer.op_counts(); batch calls count one op each)
+STORE_OPS = ("seal", "seal_batch", "lookup", "lookup_batch", "free",
+             "locations", "contains", "fetch_ranges", "fetch_payload",
+             "store_payload")
+
+
+def run_consolidate_config(session, rows, maps, buckets):
+    """The many-partition shuffle (M maps x B buckets, small rows) with the
+    consolidated fast path off then on; returns the record."""
+    from raydp_tpu.runtime import get_runtime
+
+    rng = np.random.RandomState(11)
+    pdf = pd.DataFrame({"k": rng.randint(0, 1_000_000, rows),
+                        "v": rng.randint(0, 1_000_000, rows)})
+    df = session.createDataFrame(pdf, num_partitions=maps)
+    server = get_runtime().store_server
+    out = {"maps": maps, "buckets": buckets, "rows": rows}
+    tables = {}
+    for mode, env in (("naive", "0"), ("consolidated", "1")):
+        os.environ["RDT_SHUFFLE_CONSOLIDATE"] = env
+        session.engine.reset_shuffle_stage_report()
+        server.reset_op_counts()
+        t0 = time.perf_counter()
+        table = df.repartition(buckets).to_arrow()
+        out[f"wall_{mode}_s"] = round(time.perf_counter() - t0, 4)
+        ops = server.op_counts()
+        out[f"store_rpcs_{mode}"] = sum(ops.get(k, 0) for k in STORE_OPS)
+        report = session.engine.shuffle_stage_report()
+        out[f"bytes_{mode}"] = sum(r["bytes_shuffled"] for r in report)
+        out[f"stage_meta_rpcs_{mode}"] = sum(r["meta_rpcs"] for r in report)
+        tables[mode] = table.sort_by([("k", "ascending"),
+                                      ("v", "ascending")])
+    os.environ.pop("RDT_SHUFFLE_CONSOLIDATE", None)
+    out["rpc_reduction_x"] = round(
+        out["store_rpcs_naive"] / max(out["store_rpcs_consolidated"], 1), 2)
+    out["identical"] = tables["naive"].equals(tables["consolidated"])
     return out
 
 
@@ -124,6 +175,12 @@ def main():
             record["configs"][f"join_{name}"] = dict(
                 cardinality=card,
                 **run_config(session, join_action, ["k", "c0"]))
+
+        # control-plane leg: many small partitions, where per-object fixed
+        # costs dominate and consolidation + batched metadata matter most
+        mp, bk = (16, 16) if smoke else (64, 64)
+        record["configs"]["repartition_many"] = run_consolidate_config(
+            session, rows=mp * (100 if smoke else 600), maps=mp, buckets=bk)
     finally:
         raydp_tpu.stop()
 
@@ -136,6 +193,13 @@ def main():
         json.dump(record, fh, indent=2, sort_keys=True)
     print(json.dumps({k: v for k, v in record.items() if k != "configs"}))
     for name, cfg in record["configs"].items():
+        if "rpc_reduction_x" in cfg:
+            print(f"{name}: store RPCs {cfg['store_rpcs_naive']} -> "
+                  f"{cfg['store_rpcs_consolidated']} "
+                  f"({cfg['rpc_reduction_x']}x), wall {cfg['wall_naive_s']}s "
+                  f"-> {cfg['wall_consolidated_s']}s, "
+                  f"identical={cfg['identical']}")
+            continue
         print(f"{name}: bytes {cfg['bytes_naive']} -> {cfg['bytes_opt']} "
               f"({cfg['reduction_x']}x), rows {cfg['rows_naive']} -> "
               f"{cfg['rows_opt']}, wall {cfg['wall_naive_s']}s -> "

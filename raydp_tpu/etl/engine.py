@@ -95,11 +95,22 @@ def _backoff_delay(attempt: int, rng: random.Random,
 
 
 def _result_refs(r: Dict[str, Any]) -> List[ObjectRef]:
-    """Store refs a task result carries (shuffle buckets and/or RETURN_REF)."""
+    """Store refs a task result carries (per-bucket shuffle blobs, ONE
+    consolidated shuffle blob, and/or RETURN_REF)."""
     refs = list(r.get("bucket_refs") or [])
+    if r.get("consolidated_ref") is not None:
+        refs.append(r["consolidated_ref"])
     if r.get("ref") is not None:
         refs.append(r["ref"])
     return refs
+
+
+def _consolidate_enabled() -> bool:
+    """Consolidated-map-output kill switch; read per action (driver side)
+    and carried on each task, so a mid-session toggle never mixes formats
+    within one stage. Same pattern as ``RDT_ETL_OPTIMIZER``."""
+    v = os.environ.get("RDT_SHUFFLE_CONSOLIDATE", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
 
 
 def _free_result_refs(results: Sequence[Optional[Dict[str, Any]]]) -> None:
@@ -529,6 +540,16 @@ class Engine:
                  "buckets": num_buckets,
                  "rows_in": rows_in, "bytes_in": bytes_in,
                  "rows_shuffled": rows, "bytes_shuffled": nbytes,
+                 # store control-plane traffic: metadata (seal/lookup) and
+                 # payload-fetch RPCs issued by this stage's map tasks;
+                 # reduce-side reads are attributed here later via
+                 # Task.consumes_stage (_attribute_consumer_rpcs)
+                 "meta_rpcs": sum(int(r.get("meta_rpcs", 0))
+                                  for r in results),
+                 "fetch_rpcs": sum(int(r.get("fetch_rpcs", 0))
+                                   for r in results),
+                 "consolidated": any(r.get("consolidated_ref") is not None
+                                     for r in results),
                  # lineage-recovery accounting: blobs regenerated for this
                  # stage's intermediates, and how many recovery events ran
                  "regenerated": 0, "recovered": 0}
@@ -554,9 +575,15 @@ class Engine:
     def shuffle_stage_report(self) -> List[Dict[str, Any]]:
         """Per-stage shuffle ledger: one dict per wide-op stage executed by
         this engine ({stage, maps, buckets, rows_in, bytes_in, rows_shuffled,
-        bytes_shuffled, regenerated, recovered}); in = entering the shuffle
-        stage (before map-side partial aggregation), shuffled = what crossed
-        the object store. ``regenerated`` counts intermediate blobs rebuilt
+        bytes_shuffled, meta_rpcs, fetch_rpcs, consolidated, regenerated,
+        recovered}); in = entering the shuffle stage (before map-side partial
+        aggregation), shuffled = what crossed the object store.
+        ``meta_rpcs``/``fetch_rpcs`` count store control-plane calls (table
+        ops / payload fetches) issued by the stage's map tasks plus its
+        reduce tasks' reads — an upper bound when tasks overlap on one
+        executor (they share process counters); the exact session totals are
+        ``ObjectStoreServer.op_counts()``. ``consolidated`` marks the
+        single-blob map output format. ``regenerated`` counts intermediate blobs rebuilt
         through lineage recovery after a store loss, ``recovered`` the
         recovery events that rebuilt them (0/0 on a fault-free run)."""
         with self._report_lock:
@@ -579,8 +606,9 @@ class Engine:
             if entry is None:
                 entry = {"stage": prod.label, "maps": 0, "buckets": 0,
                          "rows_in": 0, "bytes_in": 0, "rows_shuffled": 0,
-                         "bytes_shuffled": 0, "regenerated": 0,
-                         "recovered": 0}
+                         "bytes_shuffled": 0, "meta_rpcs": 0,
+                         "fetch_rpcs": 0, "consolidated": False,
+                         "regenerated": 0, "recovered": 0}
                 self._stage_reports.append(entry)
                 temps.stage_entries[prod.label] = entry
             prod.entry = entry
@@ -604,15 +632,46 @@ class Engine:
 
     @staticmethod
     def _gather_buckets(results: Sequence[Dict[str, Any]], num_buckets: int,
-                        temps: List[ObjectRef]) -> List[List[ObjectRef]]:
+                        temps: List[ObjectRef]) -> List[List[Any]]:
         """Transpose map-task shuffle outputs (map × bucket → bucket × map),
-        registering every intermediate ref in ``temps``."""
-        buckets: List[List[ObjectRef]] = [[] for _ in range(num_buckets)]
+        registering every intermediate ref in ``temps``. A consolidated map
+        result contributes ``(ref, offset, size)`` byte-range triples into
+        every bucket list (but only ONE temp ref — the blob); legacy results
+        contribute whole-blob :class:`ObjectRef`\\ s, so a stage can mix
+        formats and :meth:`_bucket_source` still builds a working reader."""
+        buckets: List[List[Any]] = [[] for _ in range(num_buckets)]
         for r in results:
-            for b, ref in enumerate(r["bucket_refs"]):
-                buckets[b].append(ref)
-                temps.append(ref)
+            cref = r.get("consolidated_ref")
+            if cref is not None:
+                temps.append(cref)
+                for b, (off, size, _rows) in enumerate(r["bucket_index"]):
+                    buckets[b].append((cref, int(off), int(size)))
+            else:
+                for b, ref in enumerate(r["bucket_refs"]):
+                    buckets[b].append(ref)
+                    temps.append(ref)
         return buckets
+
+    @staticmethod
+    def _bucket_source(bucket: Sequence[Any],
+                       schema: Optional[bytes]) -> T.Step:
+        """Reader step for one reduce bucket: whole-blob refs decode through
+        :class:`tasks.ArrowRefSource` as always; byte-range triples (the
+        consolidated format) through :class:`tasks.RangeRefSource` — with
+        legacy refs normalized to full-blob ranges when a stage mixes both."""
+        if any(isinstance(x, tuple) for x in bucket):
+            parts = [x if isinstance(x, tuple) else (x, 0, x.size)
+                     for x in bucket]
+            return T.RangeRefSource(parts, schema=schema)
+        return T.ArrowRefSource(list(bucket), schema=schema)
+
+    def _bucket_task(self, bucket: Sequence[Any], schema: Optional[bytes],
+                     steps: Optional[List[T.Step]], label: str) -> T.Task:
+        """A reduce task over one bucket, tagged with the stage it consumes
+        so its store-RPC counters land on that stage's ledger entry."""
+        task = self._task(self._bucket_source(bucket, schema), steps)
+        task.consumes_stage = label
+        return task
 
     @staticmethod
     def _free(temps: List[ObjectRef]) -> None:
@@ -691,6 +750,7 @@ class Engine:
                     if lineage_label is not None:
                         self._record_lineage(temps, tasks, results,
                                              lineage_label, task_bytes=blobs)
+                    self._attribute_consumer_rpcs(tasks, results, temps)
                     return results
                 except ObjectsLostError as e:
                     if e.partial is not None:
@@ -726,6 +786,27 @@ class Engine:
             # raise: free them (the pool already freed its own sub-round's)
             _free_result_refs(results)
             raise
+
+    def _attribute_consumer_rpcs(self, tasks: Sequence[T.Task],
+                                 results: Sequence[Optional[Dict[str, Any]]],
+                                 temps) -> None:
+        """Fold reduce-task store-RPC counters into the ledger entry of the
+        shuffle stage each task consumed (``Task.consumes_stage``). Tasks
+        that themselves end in a SHUFFLE write are skipped — their counters
+        already landed on the stage they PRODUCE via ``_record_stage`` (one
+        task, one entry; a join reduce reads both sides but is attributed to
+        the left label it was tagged with)."""
+        if not isinstance(temps, _ActionTemps):
+            return
+        with self._report_lock:
+            for task, r in zip(tasks, results):
+                label = getattr(task, "consumes_stage", None)
+                if label is None or r is None or task.output == T.SHUFFLE:
+                    continue
+                entry = temps.stage_entries.get(label)
+                if entry is not None:
+                    entry["meta_rpcs"] += int(r.get("meta_rpcs", 0))
+                    entry["fetch_rpcs"] += int(r.get("fetch_rpcs", 0))
 
     @staticmethod
     def _expand_lost(lost_ids: Sequence[str], tasks: Sequence[T.Task],
@@ -958,6 +1039,7 @@ class Engine:
                 self._task(T.ArrowRefSource([r], schema=schema_bytes))
                 .with_output(output=T.SHUFFLE, num_buckets=nb,
                              shuffle_seed=(base * 1_000_003 + i) & 0x7FFFFFFF,
+                             shuffle_consolidate=_consolidate_enabled(),
                              owner=self.owner)
                 for i, r in enumerate(refs)
             ]
@@ -967,9 +1049,10 @@ class Engine:
             self._record_stage("random-shuffle", results, nb, temps)
             buckets = self._gather_buckets(results, nb, temps)
             reduce_tasks = [
-                self._task(T.ArrowRefSource(bucket, schema=schema_bytes),
-                           [T.LocalShuffleStep(
-                               (base * 9_176 + 77 + b) & 0x7FFFFFFF)])
+                self._bucket_task(bucket, schema_bytes,
+                                  [T.LocalShuffleStep(
+                                      (base * 9_176 + 77 + b) & 0x7FFFFFFF)],
+                                  "random-shuffle")
                 .with_output(output=T.RETURN_REF, owner=owner or self.owner)
                 for b, bucket in enumerate(buckets)
             ]
@@ -1092,10 +1175,21 @@ class Engine:
         (RayDatasetRDD.scala:48-56, RayDPExecutor.scala:271-287)."""
         if not self.pool.multi_host():
             return [None] * len(ref_lists)
+
+        def _norm(item) -> Tuple[Optional[ObjectRef], int]:
+            # items are refs OR (ref, offset, size) range triples — weight a
+            # range by ITS size, not the whole consolidated blob's
+            if isinstance(item, tuple):
+                return item[0], max(int(item[2]), 1)
+            if item is not None:
+                return item, max(item.size, 1)
+            return None, 0
+
         try:
             seen: Dict[str, ObjectRef] = {}
             for refs in ref_lists:
-                for r in refs:
+                for item in refs:
+                    r, _ = _norm(item)
                     if r is not None:
                         seen[r.id] = r
             locs = get_client().locations(list(seen.values()))
@@ -1104,10 +1198,11 @@ class Engine:
         preferred: List[Optional[str]] = []
         for refs in ref_lists:
             weight: Dict[str, int] = {}
-            for r in refs:
+            for item in refs:
+                r, w = _norm(item)
                 host = locs.get(r.id) if r is not None else None
                 if host is not None:
-                    weight[host] = weight.get(host, 0) + max(r.size, 1)
+                    weight[host] = weight.get(host, 0) + w
             if not weight:
                 preferred.append(None)
                 continue
@@ -1166,6 +1261,7 @@ class Engine:
                                shuffle_pre_steps=len(extra),
                                output=T.SHUFFLE, num_buckets=num_buckets,
                                shuffle_keys=keys, range_key=range_key,
+                               shuffle_consolidate=_consolidate_enabled(),
                                owner=self.owner)
                  for t in tasks]
         results = self._run_stage(tasks, preferred, temps, lineage_label=label)
@@ -1187,7 +1283,7 @@ class Engine:
             return tasks, self._locality(groups)
         buckets, schema = self._shuffle_children(node.child, n, keys=None,
                                                  temps=temps, label="repartition")
-        tasks = [self._task(T.ArrowRefSource(bucket, schema=schema))
+        tasks = [self._bucket_task(bucket, schema, None, "repartition")
                  for bucket in buckets]
         return tasks, self._locality(buckets)
 
@@ -1203,14 +1299,16 @@ class Engine:
                 node.child, nb, keys=node.keys, temps=temps,
                 pre_steps=[T.GroupAggPartialStep(node.keys, partials)],
                 label="groupagg-partial")
-            tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
-                                [T.GroupAggMergeStep(node.keys, merges)])
+            tasks = [self._bucket_task(bucket, schema,
+                                       [T.GroupAggMergeStep(node.keys, merges)],
+                                       "groupagg-partial")
                      for bucket in buckets]
             return tasks, self._locality(buckets)
         buckets, schema = self._shuffle_children(node.child, nb, keys=node.keys,
                                                  temps=temps, label="groupagg")
-        tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
-                            [T.GroupAggStep(node.keys, node.aggs)])
+        tasks = [self._bucket_task(bucket, schema,
+                                   [T.GroupAggStep(node.keys, node.aggs)],
+                                   "groupagg")
                  for bucket in buckets]
         return tasks, self._locality(buckets)
 
@@ -1223,10 +1321,18 @@ class Engine:
                                                         label="join-right")
         tasks = []
         for lb, rb in zip(left_buckets, right_buckets):
-            tasks.append(self._task(
-                T.ArrowRefSource(lb, schema=lschema),
-                [T.HashJoinStep(rb, node.keys, node.right_keys, node.how,
-                                right_schema=rschema)]))
+            if any(isinstance(x, tuple) for x in rb):
+                right_parts = [x if isinstance(x, tuple) else (x, 0, x.size)
+                               for x in rb]
+                join_step = T.HashJoinStep([], node.keys, node.right_keys,
+                                           node.how, right_schema=rschema,
+                                           right_parts=right_parts)
+            else:
+                join_step = T.HashJoinStep(list(rb), node.keys,
+                                           node.right_keys, node.how,
+                                           right_schema=rschema)
+            tasks.append(self._bucket_task(lb, lschema, [join_step],
+                                           "join-left"))
         # a join task reads BOTH sides' buckets: weight locality over them
         return tasks, self._locality([list(lb) + list(rb) for lb, rb
                                       in zip(left_buckets, right_buckets)])
@@ -1290,6 +1396,7 @@ class Engine:
             self._task(T.ArrowRefSource([ref], schema=schema)).with_output(
                 output=T.SHUFFLE, num_buckets=len(boundaries) + 1,
                 range_key=(list(keys), boundaries),
+                shuffle_consolidate=_consolidate_enabled(),
                 owner=self.owner)
             for ref in refs
         ]
@@ -1299,8 +1406,8 @@ class Engine:
         buckets = self._gather_buckets(results, len(boundaries) + 1, temps)
         # buckets come out in global sort order for any direction mix (the
         # composite comparison honors per-key direction; nulls sort last)
-        tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
-                            [T.LocalSortStep(node.keys)])
+        tasks = [self._bucket_task(bucket, schema,
+                                   [T.LocalSortStep(node.keys)], "sort-range")
                  for bucket in buckets]
         return tasks, self._locality(buckets)
 
@@ -1313,8 +1420,8 @@ class Engine:
         keys = list(node.subset) if node.subset else ["*"]
         buckets, schema = self._shuffle_children(node.child, nb, keys=keys,
                                                  temps=temps, label="distinct")
-        tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
-                            [T.DistinctStep(node.subset)])
+        tasks = [self._bucket_task(bucket, schema,
+                                   [T.DistinctStep(node.subset)], "distinct")
                  for bucket in buckets]
         return tasks, self._locality(buckets)
 
@@ -1347,8 +1454,7 @@ class Engine:
             buckets, schema = self._shuffle_children(
                 child, nb, keys=list(node.partition_keys), temps=temps,
                 label="window")
-            tasks = [self._task(T.ArrowRefSource(bucket, schema=schema),
-                                list(steps))
+            tasks = [self._bucket_task(bucket, schema, list(steps), "window")
                      for bucket in buckets]
             return tasks, self._locality(buckets)
         refs, schema, _ = self._materialize_inner(child, None, temps)

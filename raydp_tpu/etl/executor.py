@@ -134,6 +134,20 @@ class EtlExecutor:
         rule = faults.check("executor.run_task", key=task.task_id)
         if rule is not None:
             faults.apply(rule, "executor.run_task")
+        client = get_client()
+        # per-task store control-plane deltas for the engine's shuffle ledger.
+        # Concurrent tasks share the process counters, so an op can land in
+        # every overlapping task's window: the per-stage sums are an upper
+        # bound under concurrency, good for relative comparisons — the exact
+        # session-wide numbers live in ObjectStoreServer.op_counts()
+        rpc0 = client.rpc_counters()
+
+        def _with_rpcs(result: Dict[str, Any]) -> Dict[str, Any]:
+            rpc1 = client.rpc_counters()
+            result["meta_rpcs"] = rpc1["meta"] - rpc0["meta"]
+            result["fetch_rpcs"] = rpc1["fetch"] - rpc0["fetch"]
+            return result
+
         pre = (int(getattr(task, "shuffle_pre_steps", 0) or 0)
                if task.output == T.SHUFFLE else 0)
         rows_in = bytes_in = None
@@ -152,30 +166,30 @@ class EtlExecutor:
                         table = step.run(table)
             else:
                 table = T.run_task_body(task)
-        client = get_client()
         owner = task.owner
 
         if task.output == T.ROWCOUNT:
-            return {"num_rows": table.num_rows}
+            return _with_rpcs({"num_rows": table.num_rows})
 
         if task.output == T.COLLECT:
             sink = pa.BufferOutputStream()
             with pa.ipc.new_stream(sink, table.schema) as w:
                 w.write_table(table)
-            return {"ipc": sink.getvalue().to_pybytes(), "num_rows": table.num_rows}
+            return _with_rpcs({"ipc": sink.getvalue().to_pybytes(),
+                               "num_rows": table.num_rows})
 
         if task.output == T.CACHE:
             assert task.cache_key is not None
             stamp = uuid.uuid4().hex
             self.cache.put(task.cache_key, table, stamp)
-            return {
+            return _with_rpcs({
                 "num_rows": table.num_rows,
                 "nbytes": table.nbytes,
                 "cache_key": task.cache_key,
                 "cache_stamp": stamp,
                 "executor": self._actor_name,
                 "schema": table.schema.serialize().to_pybytes(),
-            }
+            })
 
         if task.output == T.SHUFFLE:
             with profiler.trace("shuffle:bucket", "etl",
@@ -199,14 +213,36 @@ class EtlExecutor:
                     start = T.hash_bytes(task.task_id) % max(task.num_buckets, 1)
                     buckets = T.round_robin_buckets(table, task.num_buckets,
                                                     start)
-            refs = [client.put_arrow(b, owner=owner) for b in buckets]
+            consolidated_index = None
+            if getattr(task, "shuffle_consolidate", False):
+                # consolidated map output: every bucket serialized
+                # back-to-back as independent Arrow IPC streams into ONE blob
+                # (a single arena allocation), sealed with a single RPC; the
+                # (offset, size, rows) index lets each reduce task read only
+                # its bucket's byte range (tasks.RangeRefSource)
+                sink = pa.BufferOutputStream()
+                consolidated_index = []
+                for b in buckets:
+                    start = sink.tell()
+                    with pa.ipc.new_stream(sink, b.schema) as w:
+                        w.write_table(b)
+                    consolidated_index.append(
+                        (int(start), int(sink.tell() - start), b.num_rows))
+                ref = client.put_raw(memoryview(sink.getvalue()),
+                                     owner=owner)
+                refs = [ref]
+            else:
+                refs = [client.put_arrow(b, owner=owner) for b in buckets]
             rule = faults.check("shuffle.write", key=task.task_id)
             if rule is not None:
                 if rule.action == "drop" and refs:
                     # the blob is written, its ref handed to the driver — and
                     # the payload silently dies before the reduce stage reads
                     # it (the store-host-died model the lineage ledger
-                    # exists for)
+                    # exists for). On the consolidated path there is exactly
+                    # ONE blob per map task — bucket= wraps onto it, so the
+                    # drop takes every bucket at once and recovery must
+                    # rebuild the whole consolidated output
                     victim = refs[rule.bucket % len(refs)]
                     try:
                         client.free([victim])
@@ -234,10 +270,10 @@ class EtlExecutor:
             shuffle_bytes = sum(int(getattr(r, "size", 0) or 0) for r in refs)
             with profiler.trace("shuffle:write", "etl", task_id=task.task_id,
                                 rows_out=table.num_rows,
-                                bytes_out=shuffle_bytes):
+                                bytes_out=shuffle_bytes,
+                                consolidated=consolidated_index is not None):
                 pass
-            return {
-                "bucket_refs": refs,
+            result = {
                 "num_rows": table.num_rows,
                 "shuffle_bytes": shuffle_bytes,
                 # pre-shuffle-stage size (differs from num_rows/bytes out
@@ -249,15 +285,21 @@ class EtlExecutor:
                 else table.nbytes,
                 "schema": table.schema.serialize().to_pybytes(),
             }
+            if consolidated_index is not None:
+                result["consolidated_ref"] = refs[0]
+                result["bucket_index"] = consolidated_index
+            else:
+                result["bucket_refs"] = refs
+            return _with_rpcs(result)
 
         # default: RETURN_REF
         ref = client.put_arrow(table, owner=owner)
-        return {
+        return _with_rpcs({
             "ref": ref,
             "num_rows": table.num_rows,
             "nbytes": table.nbytes,
             "schema": table.schema.serialize().to_pybytes(),
-        }
+        })
 
     # -- data-plane server (parity: getRDDPartition) ---------------------------
     def get_block(self, cache_key: str, recover_bytes: Optional[bytes] = None,

@@ -111,6 +111,18 @@ class ParquetSource(Step):
         return f.read_row_groups(self.row_groups, columns=self.columns)
 
 
+def concat_or_empty(tables: List[pa.Table],
+                    schema: Optional[bytes]) -> pa.Table:
+    """Concat bucket/block tables; an empty input list falls back to the
+    serialized schema (shared by :class:`ArrowRefSource` and
+    :class:`RangeRefSource` so both sources agree on the 0-ref case)."""
+    if not tables:
+        if schema is not None:
+            return pa.ipc.read_schema(pa.py_buffer(schema)).empty_table()
+        raise ValueError("ref source with no refs and no schema")
+    return pa.concat_tables(tables, promote_options="permissive")
+
+
 @dataclass
 class ArrowRefSource(Step):
     """Concatenate Arrow tables from object-store refs (zero-copy reads)."""
@@ -120,13 +132,39 @@ class ArrowRefSource(Step):
 
     def load(self) -> pa.Table:
         client = get_client()
-        tables = [client.get(r) for r in self.refs]
-        tables = [t for t in tables if t.num_rows >= 0]
-        if not tables:
-            if self.schema is not None:
-                return pa.ipc.read_schema(pa.py_buffer(self.schema)).empty_table()
-            raise ValueError("ArrowRefSource with no refs and no schema")
-        return pa.concat_tables(tables, promote_options="permissive")
+        return concat_or_empty([client.get(r) for r in self.refs],
+                               self.schema)
+
+
+@dataclass
+class RangeRefSource(Step):
+    """Byte-range reads of store blobs: ``(ref, offset, size)`` triples, each
+    range an independent Arrow IPC stream — the reduce-side reader of the
+    consolidated shuffle path (a map task's B buckets live back-to-back in
+    ONE blob; each reduce task decodes only its bucket's slice). Sibling of
+    :class:`SlicedRefSource`, but byte-range rather than row-range. A
+    full-blob part ``(ref, 0, ref.size)`` reads a legacy single-bucket blob
+    identically, so mixed stages decode fine.
+
+    The fetch is batched: one ``lookup_batch`` for all refs (memo hits are
+    free), local slices zero-copy out of the attached segment, and one
+    ``store_fetch_ranges`` RPC per remote payload host (threaded across
+    hosts) — O(hosts) round-trips per reduce task instead of O(maps)."""
+
+    parts: List[Tuple[ObjectRef, int, int]]
+    schema: Optional[bytes] = None  # serialized schema for the 0-part case
+
+    def load(self) -> pa.Table:
+        from raydp_tpu import profiler
+
+        client = get_client()
+        total = sum(size for _, _, size in self.parts)
+        with profiler.trace("shuffle:fetch", "etl", parts=len(self.parts),
+                            bytes=total):
+            bufs = client.get_range_buffers(self.parts)
+        tables = [pa.ipc.open_stream(pa.py_buffer(b)).read_all()
+                  for b in bufs]
+        return concat_or_empty(tables, self.schema)
 
 
 @dataclass
@@ -626,16 +664,26 @@ class GroupAggMergeStep(Step):
 
 @dataclass
 class HashJoinStep(Step):
-    """Join the incoming (left bucket) table against the right bucket refs."""
+    """Join the incoming (left bucket) table against the right bucket refs.
+
+    ``right_parts`` (byte-range triples) carries the right side when it was
+    shuffled through consolidated map outputs; otherwise ``right_refs``
+    holds whole-blob refs, exactly as before."""
 
     right_refs: List[ObjectRef]
     keys: List[str]
     right_keys: List[str]
     how: str = "inner"
     right_schema: Optional[bytes] = None
+    right_parts: Optional[List[Tuple[ObjectRef, int, int]]] = None
 
     def run(self, table: pa.Table) -> pa.Table:
-        right = ArrowRefSource(self.right_refs, schema=self.right_schema).load()
+        if self.right_parts is not None:
+            right = RangeRefSource(self.right_parts,
+                                   schema=self.right_schema).load()
+        else:
+            right = ArrowRefSource(self.right_refs,
+                                   schema=self.right_schema).load()
         return table.join(right, keys=self.keys, right_keys=self.right_keys,
                           join_type=self.how)
 
@@ -670,6 +718,14 @@ class Task:
     # aggregation): the executor measures rows/bytes entering the shuffle
     # stage BEFORE these run, so the in/out counters show the reduction
     shuffle_pre_steps: int = 0
+    # SHUFFLE output writes all buckets as ONE consolidated blob (back-to-back
+    # IPC streams + per-bucket index) sealed with a single RPC; decided by the
+    # driver per action (RDT_SHUFFLE_CONSOLIDATE) so a mid-session toggle
+    # never splits one stage across the two formats
+    shuffle_consolidate: bool = False
+    # the shuffle-stage label this task READS (set on reduce tasks): its
+    # store-RPC counters are attributed to that stage's ledger entry
+    consumes_stage: Optional[str] = None
 
     def with_output(self, **kw) -> "Task":
         d = self.__dict__.copy()
@@ -694,10 +750,12 @@ def task_input_ids(task: Task) -> List[str]:
     def _step(step: Step) -> None:
         if isinstance(step, ArrowRefSource):
             ids.extend(r.id for r in step.refs)
-        elif isinstance(step, SlicedRefSource):
+        elif isinstance(step, (SlicedRefSource, RangeRefSource)):
             ids.extend(r.id for r, _, _ in step.parts)
         elif isinstance(step, HashJoinStep):
             ids.extend(r.id for r in step.right_refs)
+            if step.right_parts is not None:
+                ids.extend(r.id for r, _, _ in step.right_parts)
         elif isinstance(step, CachedSource) and step.recover is not None:
             ids.extend(task_input_ids(step.recover))
 
@@ -713,14 +771,23 @@ def _patch_step_refs(step: Step, mapping: Dict[str, ObjectRef]) -> Step:
         refs = [mapping.get(r.id, r) for r in step.refs]
         if refs != step.refs:
             return dataclasses.replace(step, refs=refs)
-    elif isinstance(step, SlicedRefSource):
+    elif isinstance(step, (SlicedRefSource, RangeRefSource)):
+        # offsets/sizes survive the swap: producer reruns are deterministic,
+        # so a regenerated consolidated blob is byte-identical and the
+        # bucket index still addresses it
         parts = [(mapping.get(r.id, r), o, n) for r, o, n in step.parts]
         if parts != step.parts:
             return dataclasses.replace(step, parts=parts)
     elif isinstance(step, HashJoinStep):
         refs = [mapping.get(r.id, r) for r in step.right_refs]
-        if refs != step.right_refs:
-            return dataclasses.replace(step, right_refs=refs)
+        parts = step.right_parts
+        if parts is not None:
+            new_parts = [(mapping.get(r.id, r), o, n) for r, o, n in parts]
+            if new_parts != parts:
+                parts = new_parts
+        if refs != step.right_refs or parts is not step.right_parts:
+            return dataclasses.replace(step, right_refs=refs,
+                                       right_parts=parts)
     elif isinstance(step, CachedSource) and step.recover is not None:
         recover = patch_task_refs(step.recover, mapping)
         if recover is not step.recover:

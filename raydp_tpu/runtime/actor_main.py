@@ -66,7 +66,21 @@ class _ActorServer:
 
 def _delayed_exit():
     time.sleep(0.2)
+    _close_store()
     os._exit(0)
+
+
+def _close_store():
+    """Close this actor process's store client before exit: detaches cached
+    segment handles and closes peer payload-host connections, so a graceful
+    executor shutdown (or a scale-down cycle) does not strand sockets on the
+    node agents it fetched from."""
+    try:
+        client = objstore._client  # noqa: SLF001 (process-global)
+        if client is not None:
+            client.close()
+    except Exception:
+        pass
 
 
 def main() -> None:
@@ -117,6 +131,7 @@ def main() -> None:
         pass
     finally:
         server.stop()
+        _close_store()
         os._exit(0)
 
 

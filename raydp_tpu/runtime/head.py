@@ -116,8 +116,23 @@ class HeadService:
     def store_seal(self, *a):
         return self._rt.store_server.seal(*a)
 
+    def store_seal_batch(self, *a):
+        return self._rt.store_server.seal_batch(*a)
+
     def store_lookup(self, *a):
         return self._rt.store_server.lookup(*a)
+
+    def store_lookup_batch(self, *a):
+        return self._rt.store_server.lookup_batch(*a)
+
+    def store_fetch_ranges(self, *a):
+        return self._rt.store_server.fetch_ranges(*a)
+
+    def store_op_counts(self, *a):
+        return self._rt.store_server.op_counts(*a)
+
+    def store_reset_op_counts(self, *a):
+        return self._rt.store_server.reset_op_counts(*a)
 
     def store_contains(self, *a):
         return self._rt.store_server.contains(*a)

@@ -87,6 +87,17 @@ class NodeAgentService:
         the one-hop node-to-node transfer of the distributed data plane."""
         return self._agent.payload_host.fetch(segment, offset, size)
 
+    def store_fetch_ranges(self, items) -> list:
+        """Many byte ranges of payloads hosted here in ONE RPC — the batched
+        reduce-side read of the consolidated shuffle path: a reduce task
+        fetches its bucket's slice of every map output on this machine with
+        a single round-trip instead of one per blob. Each item is
+        ``(segment, base, start, size)``: the payload's table offset (arena
+        offset, -1 for a dedicated segment) and the range offset within it."""
+        return [self._agent.payload_host.fetch_range(seg, int(base),
+                                                     int(start), int(size))
+                for seg, base, start, size in items]
+
     def store_release(self, items, defer_segments: bool = False) -> int:
         return self._agent.payload_host.release(
             [(seg, int(off)) for seg, off in items],

@@ -37,7 +37,7 @@ import secrets
 import threading
 from dataclasses import dataclass, field
 from multiprocessing import resource_tracker, shared_memory
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
 import pyarrow as pa
@@ -162,15 +162,25 @@ class PayloadHost:
     # -- payload IO ------------------------------------------------------------
     def fetch(self, segment: str, offset: int, size: int) -> bytes:
         """Payload bytes for a reader on ANOTHER machine (one direct hop)."""
-        if offset >= 0:
+        return self.fetch_range(segment, offset, 0, size)
+
+    def fetch_range(self, segment: str, base: int, start: int,
+                    size: int) -> bytes:
+        """A byte range of a payload hosted here: ``base`` locates the
+        payload (its arena offset, or -1 for a dedicated segment — the same
+        convention the object table records), ``start`` is the range offset
+        WITHIN the payload. The two cannot be folded into one absolute
+        offset: a positive value means "arena" to this plane, so a ranged
+        read of a dedicated segment must keep them apart."""
+        if base >= 0:
             with self._arena_lock:
                 if self._arena is None or segment != self._arena.segment:
                     raise KeyError(f"arena segment {segment} not hosted here")
-                return bytes(self._arena.view(offset, size))
+                return bytes(self._arena.view(base + start, size))
         shm = shared_memory.SharedMemory(name=segment)
         try:
             _untrack(shm)
-            return bytes(shm.buf[:size])
+            return bytes(shm.buf[start:start + size])
         finally:
             shm.close()
 
@@ -271,6 +281,12 @@ class ObjectStoreServer:
         #: head-mediated payload RPC counters — the distributed-plane tests
         #: assert these stay flat while cross-node traffic flows node→node
         self.payload_rpc_count = 0
+        # per-method control-plane op counters: how many table operations the
+        # session issued (a seal_batch of 100 entries counts ONE op — that is
+        # the point of batching; benchmarks read these to fence the
+        # metadata-plane reduction of the consolidated shuffle path)
+        self._op_lock = threading.Lock()
+        self._op_counts: Dict[str, int] = {}
         # callbacks wired by RuntimeContext for payloads on agent machines
         self.node_release = None  # (host_id, [(segment, offset)]) -> None
         self.node_fetch = None    # (host_id, segment, offset, size) -> bytes
@@ -292,6 +308,21 @@ class ObjectStoreServer:
         self._fault_gen = 0        # fault-in segments get fresh names (the
         #                            old name may still be alive under grace)
 
+    # -- control-plane accounting ---------------------------------------------
+    def _count_op(self, name: str) -> None:
+        with self._op_lock:
+            self._op_counts[name] = self._op_counts.get(name, 0) + 1
+
+    def op_counts(self) -> Dict[str, int]:
+        """Per-method control-plane operation counts since start/reset. A
+        batch call counts one op regardless of batch size."""
+        with self._op_lock:
+            return dict(self._op_counts)
+
+    def reset_op_counts(self) -> None:
+        with self._op_lock:
+            self._op_counts.clear()
+
     # -- arena (head machine) --------------------------------------------------
     def arena_info(self) -> Optional[Dict[str, Any]]:
         return self.host.arena_info()
@@ -306,20 +337,52 @@ class ObjectStoreServer:
     def seal(self, object_id: str, segment: str, size: int, kind: str,
              owner: str, offset: int = -1, host_id: str = HEAD_HOST,
              payload_addr: Optional[str] = None) -> None:
-        import time as _time
-        with self._lock:
-            if object_id in self._table:
-                raise KeyError(f"object {object_id} already sealed")
-            self._table[object_id] = _Entry(segment, size, kind, owner, offset,
-                                            host_id, payload_addr,
-                                            last_access=_time.monotonic())
-            if host_id == HEAD_HOST:
-                self._shm_bytes += size
-            else:
-                self._host_bytes[host_id] = \
-                    self._host_bytes.get(host_id, 0) + size
+        self._count_op("seal")
+        self._seal_locked([(object_id, segment, size, kind, owner, offset,
+                            host_id, payload_addr)])
         self.host.reap()
         self._maybe_spill(host_id, exclude=object_id)
+
+    def seal_batch(self, entries: List[Sequence]) -> None:
+        """Seal many objects in ONE control-plane operation; each entry is
+        the positional argument tuple of :meth:`seal`. All-or-nothing: a
+        duplicate id rejects the whole batch before any entry lands, so the
+        caller's rollback (release the written payloads) stays simple."""
+        self._count_op("seal_batch")
+        entries = [tuple(e) for e in entries]
+        self._seal_locked(entries)
+        self.host.reap()
+        by_host: Dict[str, set] = {}
+        for e in entries:
+            by_host.setdefault(e[6] if len(e) > 6 else HEAD_HOST,
+                               set()).add(e[0])
+        for host_id, ids in by_host.items():
+            # exclude every id the batch just sealed on this host — same
+            # immediate-re-evict guard seal() applies to its one object
+            self._maybe_spill(host_id, exclude=ids)
+
+    def _seal_locked(self, entries: List[Sequence]) -> None:
+        import time as _time
+        with self._lock:
+            for e in entries:
+                if e[0] in self._table:
+                    raise KeyError(f"object {e[0]} already sealed")
+            if len({e[0] for e in entries}) != len(entries):
+                raise KeyError("duplicate object id in seal batch")
+            now = _time.monotonic()
+            for e in entries:
+                (object_id, segment, size, kind, owner) = e[:5]
+                offset = e[5] if len(e) > 5 else -1
+                host_id = e[6] if len(e) > 6 else HEAD_HOST
+                payload_addr = e[7] if len(e) > 7 else None
+                self._table[object_id] = _Entry(segment, size, kind, owner,
+                                                offset, host_id, payload_addr,
+                                                last_access=now)
+                if host_id == HEAD_HOST:
+                    self._shm_bytes += size
+                else:
+                    self._host_bytes[host_id] = \
+                        self._host_bytes.get(host_id, 0) + size
 
     # -- eviction/spill (one implementation; per-host backends) ---------------
     def _spill_path(self, object_id: str) -> str:
@@ -402,14 +465,19 @@ class ObjectStoreServer:
         return write_spill, release_shm, fault_read, remove_spill
 
     def _maybe_spill(self, host_id: str = HEAD_HOST,
-                     exclude: Optional[str] = None) -> None:
+                     exclude=None) -> None:
         """LRU-spill sealed objects on ``host_id`` until its shm use fits its
         budget. Shm bytes are released on the view-grace deferral (segments
         included), so borrowed zero-copy views and lookup-then-attach readers
-        never see recycled bytes. Parity: plasma eviction/spill."""
+        never see recycled bytes. ``exclude`` (an id or a set of ids — a
+        seal batch protects ALL its entries) exempts just-sealed objects
+        from being the victim of their own seal. Parity: plasma
+        eviction/spill."""
         budget = self._budget_of(host_id)
         if not budget:
             return
+        excluded = (exclude if isinstance(exclude, (set, frozenset))
+                    else {exclude} if exclude is not None else set())
         while True:
             with self._lock:
                 if self._shm_used(host_id) <= budget:
@@ -417,7 +485,7 @@ class ObjectStoreServer:
                 victims = sorted(
                     ((e.last_access, oid) for oid, e in self._table.items()
                      if e.host_id == host_id and not e.spilled
-                     and e.size > 0 and oid != exclude))
+                     and e.size > 0 and oid not in excluded))
                 if not victims:
                     return
                 victim = victims[0][1]
@@ -507,7 +575,8 @@ class ObjectStoreServer:
         """Payload bytes + kind through the head — the slow compatibility path
         for shm-less clients. Machine-local readers attach segments directly;
         cross-machine readers go straight to the owning node's PayloadHost."""
-        segment, size, kind, offset, host_id, _ = self.lookup(object_id)
+        self._count_op("fetch_payload")
+        segment, size, kind, offset, host_id, _ = self._lookup_one(object_id)
         self.payload_rpc_count += 1
         if host_id != HEAD_HOST:
             if self.node_fetch is None:
@@ -519,6 +588,7 @@ class ObjectStoreServer:
     def store_payload(self, object_id: str, data: bytes, kind: str,
                       owner: str) -> int:
         """Write + seal on behalf of a shm-less client; returns the size."""
+        self._count_op("store_payload")
         self.payload_rpc_count += 1
         seg_name = f"rdt{self.session_id[:8]}_{object_id}"
         segment, offset = self.host.write(data, seg_name)
@@ -532,6 +602,27 @@ class ObjectStoreServer:
     # -- read path ------------------------------------------------------------
     def lookup(self, object_id: str
                ) -> Tuple[str, int, str, int, str, Optional[str]]:
+        self._count_op("lookup")
+        return self._lookup_one(object_id)
+
+    def lookup_batch(self, object_ids: List[str]
+                     ) -> Dict[str, Tuple[str, int, str, int, str,
+                                          Optional[str]]]:
+        """Resolve many objects in ONE control-plane operation. Missing ids
+        are simply absent from the result (the caller decides whether a miss
+        is a lost object); present-but-spilled entries fault in exactly like
+        :meth:`lookup`."""
+        self._count_op("lookup_batch")
+        out = {}
+        for oid in object_ids:
+            try:
+                out[oid] = self._lookup_one(oid)
+            except KeyError:
+                pass
+        return out
+
+    def _lookup_one(self, object_id: str
+                    ) -> Tuple[str, int, str, int, str, Optional[str]]:
         import time as _time
         # a concurrent seal can re-evict the object between our fault-in and
         # re-read (it is the LRU victim when it is the only candidate): retry
@@ -552,6 +643,7 @@ class ObjectStoreServer:
             "raise raydp.tpu.object_store.shm_budget")
 
     def contains(self, object_id: str) -> bool:
+        self._count_op("contains")
         with self._lock:
             return object_id in self._table
 
@@ -559,9 +651,22 @@ class ObjectStoreServer:
         """``object_id -> host_id`` for the ids present — the engine's
         locality source (parity: ``getBlockLocations`` / preferred locations,
         RayDPExecutor.scala:271-287, RayDatasetRDD.scala:48-56)."""
+        self._count_op("locations")
         with self._lock:
             return {oid: self._table[oid].host_id for oid in object_ids
                     if oid in self._table}
+
+    def fetch_ranges(self, items: List[Sequence]) -> List[bytes]:
+        """Byte ranges of payloads hosted on the HEAD machine, one RPC for
+        many ranges: each item is ``(segment, base, start, size)`` — the
+        payload's table offset (arena offset or -1 for a dedicated segment)
+        plus the range offset within it. This is the head acting as its
+        machine's payload host (the node-agent twin is
+        ``store_fetch_ranges``), serving consolidated shuffle blobs to
+        readers on other machines without one round-trip per range."""
+        self._count_op("fetch_ranges")
+        return [self.host.fetch_range(seg, int(base), int(start), int(size))
+                for seg, base, start, size in items]
 
     # -- lifetime: ownership-based (owner death sweeps; explicit free releases).
     # A refcount protocol is deliberately absent — every object has exactly one
@@ -569,6 +674,7 @@ class ObjectStoreServer:
     def free(self, object_ids: List[str]) -> int:
         """Explicitly delete objects regardless of owner (release path,
         parity with ``release_spark_recoverable``, dataset.py:224-237)."""
+        self._count_op("free")
         freed = []
         with self._lock:
             for oid in object_ids:
@@ -752,6 +858,30 @@ class ObjectStoreClient:
         self.session_id = session_id
         self.default_owner = default_owner
         self._attached: Dict[str, shared_memory.SharedMemory] = {}
+        #: object id → the dedicated segment this process attached for it, so
+        #: free()/loss can evict the handle (fault-in segments carry a
+        #: generation suffix — deriving the name from the id alone misses
+        #: them, which was the handle/fd leak this map fixes)
+        self._seg_of: Dict[str, str] = {}
+        # client-side lookup memo for sealed entries. Only entries whose
+        # payload CANNOT silently move under a reader are memoized: dedicated
+        # segments are written once, and any relocation (spill/fault-in) or
+        # free changes/unlinks the NAME, so a stale hit surfaces as
+        # FileNotFoundError and takes the existing one-fresh-lookup recovery.
+        # Arena-resident entries are deliberately not memoized — the arena
+        # segment name never changes, so a recycled offset would be read
+        # silently.
+        self._lookup_memo: Dict[str, Tuple] = {}
+        self._MEMO_CAP = 4096
+        #: handles whose close() failed because a borrowed view still pins
+        #: the mapping; kept strongly referenced (GC-time close would just
+        #: raise the same BufferError) and re-tried on later evictions
+        self._retired: List[shared_memory.SharedMemory] = []
+        # control-plane instrumentation: table-server calls and payload-fetch
+        # RPCs issued by THIS process (executors report per-task deltas into
+        # the engine's shuffle ledger)
+        self.meta_rpc_count = 0
+        self.fetch_rpc_count = 0
         self._lock = threading.Lock()
         self._arena = None          # native write handle, lazily probed
         self._arena_probed = False
@@ -829,15 +959,12 @@ class ObjectStoreClient:
             self._peer(self.payload_addr).call("store_reap", timeout=30.0)
 
     # -- write ----------------------------------------------------------------
-    def put_raw(self, data, kind: str = KIND_RAW, owner: Optional[str] = None) -> ObjectRef:
-        object_id = new_object_id()
+    def _write_local(self, object_id: str, data) -> Tuple[str, int]:
+        """Write payload bytes into this machine's plane (arena first with a
+        reap-retry, dedicated segment fallback); returns ``(segment, offset)``
+        with ``offset=-1`` for a dedicated segment. No metadata RPC happens
+        here — the caller seals (individually or batched)."""
         size = len(data)
-        if self.remote:
-            payload = bytes(data.cast("B")) if isinstance(data, memoryview) \
-                else bytes(data)
-            self._server.store_payload(object_id, payload, kind,
-                                       owner or self.default_owner)
-            return ObjectRef(id=object_id, size=size, kind=kind)
         arena = self._write_arena()
         if arena is not None:
             offset = arena.alloc(size)
@@ -858,9 +985,6 @@ class ObjectStoreClient:
                             view[:] = data.cast("B")
                         else:
                             view[:] = data
-                    self._server.seal(object_id, arena.segment, size, kind,
-                                      owner or self.default_owner, offset,
-                                      self.host_id, self.payload_addr)
                 except BaseException:
                     # unsealed allocation would leak until session end
                     try:
@@ -868,7 +992,7 @@ class ObjectStoreClient:
                     except Exception:
                         pass
                     raise
-                return ObjectRef(id=object_id, size=size, kind=kind)
+                return arena.segment, offset
             # arena full: fall through to a dedicated segment
         seg_name = self._segment_name(object_id)
         if size == 0:
@@ -882,10 +1006,72 @@ class ObjectStoreClient:
                 shm.buf[:size] = data
         _untrack(shm)
         shm.close()
-        self._server.seal(object_id, seg_name, size, kind,
-                          owner or self.default_owner, -1,
-                          self.host_id, self.payload_addr)
+        return seg_name, -1
+
+    def _release_local(self, items: List[Tuple[str, int]]) -> None:
+        """Roll back local payload writes that never got sealed."""
+        arena = self._write_arena()
+        for segment, offset in items:
+            try:
+                if offset >= 0:
+                    if arena is not None:
+                        arena.free(offset)
+                else:
+                    _unlink_segment(segment)
+            except Exception:
+                pass
+
+    def put_raw(self, data, kind: str = KIND_RAW, owner: Optional[str] = None) -> ObjectRef:
+        object_id = new_object_id()
+        size = len(data)
+        if self.remote:
+            payload = bytes(data.cast("B")) if isinstance(data, memoryview) \
+                else bytes(data)
+            self.meta_rpc_count += 1
+            self._server.store_payload(object_id, payload, kind,
+                                       owner or self.default_owner)
+            return ObjectRef(id=object_id, size=size, kind=kind)
+        segment, offset = self._write_local(object_id, data)
+        try:
+            self.meta_rpc_count += 1
+            self._server.seal(object_id, segment, size, kind,
+                              owner or self.default_owner, offset,
+                              self.host_id, self.payload_addr)
+        except BaseException:
+            self._release_local([(segment, offset)])
+            raise
         return ObjectRef(id=object_id, size=size, kind=kind)
+
+    def put_raw_many(self, items: Sequence[Tuple[Any, str]],
+                     owner: Optional[str] = None) -> List[ObjectRef]:
+        """Write many payloads locally and seal them with ONE ``seal_batch``
+        RPC — the batched half of the metadata plane (a map task's B shuffle
+        buckets, or createDataFrame's N chunks, used to cost one head
+        round-trip each). ``items`` are ``(data, kind)`` pairs; order is
+        preserved. All-or-nothing on the seal: a rejected batch releases
+        every payload written here."""
+        if self.remote:
+            return [self.put_raw(d, kind=k, owner=owner) for d, k in items]
+        own = owner or self.default_owner
+        refs: List[ObjectRef] = []
+        specs: List[Tuple] = []
+        written: List[Tuple[str, int]] = []
+        try:
+            for data, kind in items:
+                object_id = new_object_id()
+                size = len(data)
+                segment, offset = self._write_local(object_id, data)
+                written.append((segment, offset))
+                specs.append((object_id, segment, size, kind, own, offset,
+                              self.host_id, self.payload_addr))
+                refs.append(ObjectRef(id=object_id, size=size, kind=kind))
+            if specs:
+                self.meta_rpc_count += 1
+                self._server.seal_batch(specs)
+        except BaseException:
+            self._release_local(written)
+            raise
+        return refs
 
     def put(self, obj: Any, owner: Optional[str] = None) -> ObjectRef:
         if isinstance(obj, pa.Table):
@@ -899,7 +1085,95 @@ class ObjectStoreClient:
         buf = sink.getvalue()
         return self.put_raw(memoryview(buf), kind=KIND_ARROW, owner=owner)
 
+    def put_arrow_many(self, tables: Sequence[pa.Table],
+                       owner: Optional[str] = None) -> List[ObjectRef]:
+        """Serialize and store many tables, sealed with one batched RPC."""
+        items = []
+        for table in tables:
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, table.schema) as writer:
+                writer.write_table(table)
+            items.append((memoryview(sink.getvalue()), KIND_ARROW))
+        return self.put_raw_many(items, owner=owner)
+
     # -- read -----------------------------------------------------------------
+    def _memoize(self, object_id: str, entry: Tuple) -> None:
+        segment, size, kind, offset, host_id, payload_addr = entry
+        if offset >= 0:
+            return  # arena-resident: a recycled offset would be read silently
+        with self._lock:
+            if len(self._lookup_memo) >= self._MEMO_CAP:
+                self._lookup_memo.pop(next(iter(self._lookup_memo)))
+            self._lookup_memo[object_id] = entry
+
+    def _evict(self, object_id: str) -> None:
+        """Drop everything this process cached about an object: the lookup
+        memo entry AND the attached dedicated-segment handle (the arena
+        attachment is shared by every arena object and stays). Called on
+        free, on a lost object, and before a fresh-lookup retry — a stale
+        handle would otherwise hold the mapping (and an open fd) for the
+        life of the process."""
+        with self._lock:
+            self._lookup_memo.pop(object_id, None)
+            seg = self._seg_of.pop(object_id, None)
+            shm = self._attached.pop(seg, None) if seg is not None else None
+        if shm is not None:
+            self._close_handle(shm)
+        self._sweep_retired()
+
+    def _close_handle(self, shm: shared_memory.SharedMemory) -> None:
+        try:
+            shm.close()
+        except Exception:
+            # a borrowed view still pins the mapping: keep a strong ref and
+            # retry later (a GC-time __del__ would raise the same
+            # BufferError, just noisily)
+            with self._lock:
+                self._retired.append(shm)
+
+    def _sweep_retired(self) -> None:
+        with self._lock:
+            retired, self._retired = self._retired, []
+        for shm in retired:
+            self._close_handle(shm)
+
+    def _lookup_entry(self, object_id: str, fresh: bool = False) -> Tuple:
+        if not fresh:
+            with self._lock:
+                hit = self._lookup_memo.get(object_id)
+            if hit is not None:
+                return hit
+        elif not self.remote:
+            self._evict(object_id)
+        self.meta_rpc_count += 1
+        entry = tuple(self._server.lookup(object_id))
+        self._memoize(object_id, entry)
+        return entry
+
+    def lookup_many(self, object_ids: Sequence[str],
+                    fresh: bool = False) -> Dict[str, Tuple]:
+        """Resolve many objects with at most ONE ``lookup_batch`` RPC (memo
+        hits cost nothing). Missing ids are absent from the result."""
+        out: Dict[str, Tuple] = {}
+        todo: List[str] = []
+        for oid in dict.fromkeys(object_ids):
+            hit = None
+            if not fresh:
+                with self._lock:
+                    hit = self._lookup_memo.get(oid)
+            elif not self.remote:
+                self._evict(oid)
+            if hit is not None:
+                out[oid] = hit
+            else:
+                todo.append(oid)
+        if todo:
+            self.meta_rpc_count += 1
+            for oid, entry in self._server.lookup_batch(todo).items():
+                entry = tuple(entry)
+                self._memoize(oid, entry)
+                out[oid] = entry
+        return out
     def _attach(self, object_id: str) -> Tuple[memoryview, str]:
         rule = faults.check("store.get", key=object_id)
         if rule is not None:
@@ -919,8 +1193,8 @@ class ObjectStoreClient:
             except FileNotFoundError:
                 # the payload moved (spill eviction recycled the segment
                 # between our lookup and attach): one fresh lookup resolves
-                # the new home
-                return self._attach_once(object_id)
+                # the new home (and evicts the stale memo entry + handle)
+                return self._attach_once(object_id, fresh=True)
             except Exception as e:
                 # the same lookup/attach race through an RPC proxy: the
                 # server's FileNotFoundError arrives as a RemoteError, so it
@@ -928,14 +1202,17 @@ class ObjectStoreClient:
                 # blob must not be escalated to "lost" (which bypasses task
                 # retry and re-executes its producer)
                 if getattr(e, "exc_type", None) == "FileNotFoundError":
-                    return self._attach_once(object_id)
+                    return self._attach_once(object_id, fresh=True)
                 raise
         except ObjectLostError:
+            self._evict(object_id)
             raise
         except KeyError as e:
             # table lookup miss (head in-process) — the blob is gone
+            self._evict(object_id)
             raise ObjectLostError(object_id, "not in store table") from e
         except FileNotFoundError as e:
+            self._evict(object_id)
             raise ObjectLostError(object_id, f"segment vanished: {e}") from e
         except Exception as e:
             # lookup/fetch through an RPC proxy surfaces the server's
@@ -944,16 +1221,19 @@ class ObjectStoreClient:
             # avoid importing rpc
             if getattr(e, "exc_type", None) in (
                     "KeyError", "ObjectLostError", "FileNotFoundError"):
+                self._evict(object_id)
                 raise ObjectLostError(object_id, "blob unreachable: "
                                       f"{getattr(e, 'message', e)}") from e
             raise
 
-    def _attach_once(self, object_id: str) -> Tuple[memoryview, str]:
+    def _attach_once(self, object_id: str,
+                     fresh: bool = False) -> Tuple[memoryview, str]:
         if self.remote:
+            self.fetch_rpc_count += 1
             data, kind = self._server.fetch_payload(object_id)
             return memoryview(data), kind
         segment, size, kind, offset, host_id, payload_addr = \
-            self._server.lookup(object_id)
+            self._lookup_entry(object_id, fresh=fresh)
         if host_id != self.host_id:
             # payload lives on another machine: ONE direct hop to the owning
             # node's payload server (never through the head — parity with
@@ -963,6 +1243,7 @@ class ObjectStoreClient:
                 try:
                     # bounded: a wedged-but-connected owner must fail the
                     # read into task retry / lineage recovery, not hang it
+                    self.fetch_rpc_count += 1
                     data = self._peer(payload_addr).call(
                         "store_fetch", segment, offset, size, timeout=60.0)
                 except (OSError, _cf.TimeoutError, TimeoutError) as e:
@@ -984,17 +1265,28 @@ class ObjectStoreClient:
                             from e
                     raise
             else:  # owner is the head machine; the table server serves it
+                self.fetch_rpc_count += 1
                 data, kind = self._server.fetch_payload(object_id)
             return memoryview(data), kind
+        view = self._local_view(object_id, segment, offset, size)
+        return view, kind
+
+    def _local_view(self, object_id: str, segment: str, offset: int,
+                    size: int) -> memoryview:
+        """Zero-copy view of a same-machine payload, attaching (and caching)
+        the segment handle. Dedicated segments are recorded per object id so
+        free/loss can evict the handle."""
         with self._lock:
             shm = self._attached.get(segment)
             if shm is None:
                 shm = shared_memory.SharedMemory(name=segment)
                 _untrack(shm)
                 self._attached[segment] = shm
+            if offset < 0:
+                self._seg_of[object_id] = segment
         if offset >= 0:
-            return shm.buf[offset:offset + size], kind
-        return shm.buf[:size], kind
+            return shm.buf[offset:offset + size]
+        return shm.buf[:size]
 
     def get_buffer(self, ref: ObjectRef) -> memoryview:
         """Borrowed zero-copy view; valid only until the object is freed."""
@@ -1017,44 +1309,193 @@ class ObjectStoreClient:
     def get_many(self, refs: List[ObjectRef], zero_copy: bool = False) -> List[Any]:
         return [self.get(r, zero_copy=zero_copy) for r in refs]
 
+    # -- ranged reads (consolidated shuffle blobs) -----------------------------
+    def get_range_buffers(self, parts: Sequence[Tuple[ObjectRef, int, int]]
+                          ) -> List[bytes]:
+        """Payload byte ranges: ``(ref, offset, size)`` per part, offsets
+        relative to the payload. Control traffic is batched — ONE
+        ``lookup_batch`` for all distinct refs (memo hits free), then one
+        ``store_fetch_ranges`` RPC per remote payload host, fanned out on
+        threads across distinct hosts; same-machine ranges are sliced out of
+        the attached segment with no RPC at all. A vanished segment gets the
+        standard one-fresh-lookup retry before escalating to
+        :class:`ObjectLostError`."""
+        if not parts:
+            return []
+        if self.remote:
+            # compatibility path (shm-less client): one head-mediated fetch
+            # per DISTINCT blob, sliced locally. Losses get the same typed
+            # translation as _attach — a table miss must route into lineage
+            # recovery, not fail the stage as a bare KeyError
+            blobs: Dict[str, bytes] = {}
+            for ref, _, _ in parts:
+                if ref.id in blobs:
+                    continue
+                self.fetch_rpc_count += 1
+                try:
+                    data, _ = self._server.fetch_payload(ref.id)
+                except ObjectLostError:
+                    raise
+                except (KeyError, FileNotFoundError) as e:
+                    raise ObjectLostError(ref.id,
+                                          "not in store table") from e
+                except Exception as e:
+                    if getattr(e, "exc_type", None) in (
+                            "KeyError", "ObjectLostError",
+                            "FileNotFoundError"):
+                        raise ObjectLostError(
+                            ref.id, "blob unreachable: "
+                            f"{getattr(e, 'message', e)}") from e
+                    raise
+                blobs[ref.id] = data
+            return [bytes(blobs[ref.id][off:off + size])
+                    for ref, off, size in parts]
+        try:
+            return self._get_ranges_once(parts, fresh=False)
+        except ObjectLostError:
+            raise
+        except (FileNotFoundError, KeyError):
+            # stale location (spill/fault-in moved the payload between our
+            # lookup and read): one fresh lookup resolves the new home
+            return self._get_ranges_once(parts, fresh=True)
+        except Exception as e:
+            if getattr(e, "exc_type", None) in ("FileNotFoundError",
+                                                "KeyError"):
+                return self._get_ranges_once(parts, fresh=True)
+            raise
+
+    def _get_ranges_once(self, parts: Sequence[Tuple[ObjectRef, int, int]],
+                         fresh: bool) -> List[bytes]:
+        ids = [ref.id for ref, _, _ in parts]
+        entries = self.lookup_many(ids, fresh=fresh)
+        missing = next((oid for oid in ids if oid not in entries), None)
+        if missing is not None:
+            self._evict(missing)
+            raise ObjectLostError(missing, "not in store table")
+        out: List[Optional[bytes]] = [None] * len(parts)
+        # group remote ranges per payload host; local ones slice immediately.
+        # Remote items carry (index, segment, base, start, size, oid): base
+        # is the payload's table offset (arena offset / -1 for a dedicated
+        # segment) and start the range offset within the payload — the
+        # payload host needs both to route arena vs segment reads.
+        groups: Dict[Optional[str],
+                     List[Tuple[int, str, int, int, int, str]]] = {}
+        for i, (ref, off, size) in enumerate(parts):
+            segment, esize, kind, eoff, host_id, addr = entries[ref.id]
+            if off + size > esize:
+                raise ValueError(
+                    f"range [{off}, {off + size}) exceeds payload size "
+                    f"{esize} of object {ref.id}")
+            if host_id == self.host_id:
+                try:
+                    # whole-payload view (zero-copy), then slice the range
+                    view = self._local_view(ref.id, segment, eoff, esize)
+                except FileNotFoundError:
+                    if fresh:
+                        # the segment is gone even after a fresh lookup: the
+                        # blob is lost — surface the typed signal so lineage
+                        # recovery regenerates instead of the consumer
+                        # burning its retry budget on a repeating miss
+                        self._evict(ref.id)
+                        raise ObjectLostError(
+                            ref.id, "segment vanished") from None
+                    raise
+                out[i] = bytes(view[off:off + size])
+            else:
+                groups.setdefault(addr, []).append(
+                    (i, segment, eoff, off, size, ref.id))
+
+        def _fetch_group(addr, items):
+            ranges = [(seg, base, start, size)
+                      for _, seg, base, start, size, _ in items]
+            self.fetch_rpc_count += 1
+            try:
+                if addr:
+                    chunks = self._peer(addr).call(
+                        "store_fetch_ranges", ranges, timeout=60.0)
+                else:  # payloads hosted on the head machine
+                    chunks = self._server.fetch_ranges(ranges)
+            except Exception as e:
+                import concurrent.futures as _cf
+                # KeyError covers a peer arena that no longer hosts the
+                # segment (payload re-homed) — same stale-location shape as
+                # a vanished dedicated segment
+                if getattr(e, "exc_type", None) in ("FileNotFoundError",
+                                                    "KeyError") \
+                        or isinstance(e, (FileNotFoundError, KeyError)):
+                    if fresh:  # gone even after the fresh lookup: lost
+                        for item in items:
+                            self._evict(item[-1])
+                        raise ObjectLostError(
+                            items[0][-1],
+                            f"payload vanished on {addr or 'head'}: {e}") \
+                            from e
+                    raise
+                if isinstance(e, (OSError, _cf.TimeoutError, TimeoutError)) \
+                        or type(e).__name__ == "ConnectionLost":
+                    for item in items:
+                        self._evict(item[-1])
+                    raise ObjectLostError(
+                        items[0][-1],
+                        f"payload host {addr or 'head'} unreachable: {e}") \
+                        from e
+                raise
+            for item, chunk in zip(items, chunks):
+                out[item[0]] = chunk
+
+        if len(groups) == 1:
+            addr, items = next(iter(groups.items()))
+            _fetch_group(addr, items)
+        elif groups:
+            import concurrent.futures as _cf
+            with _cf.ThreadPoolExecutor(
+                    max_workers=min(4, len(groups))) as pool:
+                futs = [pool.submit(_fetch_group, addr, items)
+                        for addr, items in groups.items()]
+                for f in futs:
+                    f.result()
+        return out  # type: ignore[return-value]
+
     # -- lifetime -------------------------------------------------------------
     def free(self, refs: List[ObjectRef]) -> int:
         ids = [r.id for r in refs]
-        self._release_attached(ids)
+        for oid in ids:
+            self._evict(oid)
+        self.meta_rpc_count += 1
         return self._server.free(ids)
 
     def transfer_ownership(self, refs: List[ObjectRef], new_owner: str) -> int:
+        self.meta_rpc_count += 1
         return self._server.transfer_ownership([r.id for r in refs], new_owner)
 
     def contains(self, ref: ObjectRef) -> bool:
+        self.meta_rpc_count += 1
         return self._server.contains(ref.id)
 
     def locations(self, refs: List[ObjectRef]) -> Dict[str, str]:
         """``object_id -> host_id`` (the machine holding each payload)."""
+        self.meta_rpc_count += 1
         return self._server.locations([r.id for r in refs])
 
     def stats(self) -> Dict[str, Any]:
         return self._server.stats()
 
-    def _release_attached(self, ids: List[str]) -> None:
-        with self._lock:
-            for oid in ids:
-                seg = self._segment_name(oid)
-                shm = self._attached.pop(seg, None)
-                if shm is not None:
-                    try:
-                        shm.close()
-                    except Exception:
-                        pass
+    def rpc_counters(self) -> Dict[str, int]:
+        """Control-plane calls this process issued: ``meta`` (table server)
+        and ``fetch`` (payload-fetch RPCs; zero on the pure local-shm path)."""
+        return {"meta": self.meta_rpc_count, "fetch": self.fetch_rpc_count}
 
     def close(self) -> None:
+        self._sweep_retired()
         with self._lock:
             for shm in self._attached.values():
                 try:
                     shm.close()
                 except Exception:
-                    pass
+                    self._retired.append(shm)
             self._attached.clear()
+            self._seg_of.clear()
+            self._lookup_memo.clear()
             for client in self._peers.values():
                 try:
                     client.close()

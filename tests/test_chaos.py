@@ -110,6 +110,35 @@ def test_dropped_shuffle_bucket_lineage_recovery(tmp_path, monkeypatch):
     assert sum(e.get("recovered", 0) for e in report) >= 1, report
 
 
+def test_dropped_consolidated_map_blob_recovery(tmp_path, monkeypatch):
+    """Consolidated shuffle path (explicitly pinned on): a map task's output
+    is ONE blob holding every bucket, so ``shuffle.write:drop`` must target
+    that single consolidated oid — and one regenerated producer restores all
+    B buckets at once. The reduce stage hits ObjectLostError on its byte
+    range, lineage reruns the producer (byte-identical, so the bucket index
+    still addresses the fresh blob), and the action result matches the
+    fault-free run exactly with the recovery surfaced in the ledger."""
+    monkeypatch.setenv("RDT_SHUFFLE_CONSOLIDATE", "1")
+    base, base_n, base_report = _run_groupagg("chaos-consol-base")
+    assert all(e["consolidated"] for e in base_report), base_report
+
+    sent = str(tmp_path / "consol-drop.sentinel")
+    # bucket=3 would pick bucket 3 of a legacy map output; the consolidated
+    # map has exactly one blob, so the victim index wraps onto it
+    monkeypatch.setenv("RDT_FAULTS",
+                       f"shuffle.write:drop:nth=2:bucket=3:once={sent}")
+    got, got_n, report = _run_groupagg("chaos-consol-drop")
+    assert os.path.exists(sent), "injected drop never fired"
+    assert got_n == base_n
+    assert got == base
+    entries = [e for e in report if e.get("recovered", 0) >= 1]
+    assert entries, report
+    # the regenerated producer is a consolidated map task: ONE blob rebuilt
+    # brings back every bucket, so a single recovery event suffices
+    assert all(e["consolidated"] for e in entries)
+    assert sum(e.get("regenerated", 0) for e in report) >= 1, report
+
+
 def test_dropped_bucket_without_recovery_raises_stage_error(tmp_path,
                                                             monkeypatch):
     """Same drop schedule with lineage recovery disabled: the action must

@@ -14,6 +14,7 @@ def test_shuffle_bench_smoke(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                RDT_SHUFFLE_BYTES_PATH=str(out_path))
     env.pop("RDT_ETL_OPTIMIZER", None)
+    env.pop("RDT_SHUFFLE_CONSOLIDATE", None)
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "benchmarks", "shuffle_bench.py"),
          "--smoke"],
@@ -23,11 +24,18 @@ def test_shuffle_bench_smoke(tmp_path):
     assert record["metric"] == "etl_shuffle_bytes" and record["smoke"]
     configs = record["configs"]
     assert set(configs) == {"groupby_low_card", "join_low_card",
-                            "groupby_high_card", "join_high_card"}
+                            "groupby_high_card", "join_high_card",
+                            "repartition_many"}
     for name, cfg in configs.items():
         assert cfg["identical"], name
-        assert 0 < cfg["bytes_opt"] < cfg["bytes_naive"], name
+        if name != "repartition_many":
+            assert 0 < cfg["bytes_opt"] < cfg["bytes_naive"], name
     # the headline: low-cardinality groupby shuffles a small multiple of
     # cardinality rows instead of every input row
     assert configs["groupby_low_card"]["reduction_x"] >= 5.0
+    # the control-plane leg: consolidated map outputs + batched metadata must
+    # cut store RPCs even at smoke scale (16 maps x 16 buckets)
+    many = configs["repartition_many"]
+    assert 0 < many["store_rpcs_consolidated"] < many["store_rpcs_naive"]
+    assert many["rpc_reduction_x"] >= 3.0
     assert record["all_identical"] is True
