@@ -155,6 +155,50 @@ def _unreachable_grace_s() -> float:
     return float(os.environ.get("RDT_EXECUTOR_WAIT_S", "60") or 0)
 
 
+# ---- speculation knobs (read per stage, so tests/benches can flip them) ----
+def _speculation_enabled() -> bool:
+    """Speculative-backup kill switch (default ON). Safe by construction:
+    task reruns are byte-identical, so either copy's bytes are valid — the
+    loser's distinct store blobs are drained and freed, never ledgered."""
+    v = os.environ.get("RDT_SPECULATION", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+def _speculation_quantile() -> float:
+    """Completion fraction a stage must reach before backups are considered
+    (LATE-style gate: a median runtime only means something once most of the
+    stage has finished)."""
+    return float(os.environ.get("RDT_SPECULATION_QUANTILE", "0.75") or 0.75)
+
+
+def _speculation_multiplier() -> float:
+    """A pending attempt is a straggler when its runtime exceeds this
+    multiple of the completed-task median."""
+    return float(os.environ.get("RDT_SPECULATION_MULTIPLIER", "1.5") or 1.5)
+
+
+def _speculation_min_s() -> float:
+    """Floor on the straggler threshold: sub-second stages never speculate
+    just because their median is tiny."""
+    return float(os.environ.get("RDT_SPECULATION_MIN_S", "1.0") or 1.0)
+
+
+class _Attempt:
+    """One in-flight copy of a task: where it runs (stable executor identity
+    + display name), when it was submitted, and whether it is a speculative
+    backup of an attempt still running elsewhere."""
+
+    __slots__ = ("i", "ident", "name", "started", "backup")
+
+    def __init__(self, i: int, ident: str, name: str, started: float,
+                 backup: bool):
+        self.i = i
+        self.ident = ident
+        self.name = name
+        self.started = started
+        self.backup = backup
+
+
 class _Producer:
     """Ledger entry: the serialized task that created a set of intermediates
     (all shuffle buckets of one map task, or one RETURN_REF block), in output
@@ -222,7 +266,15 @@ _NO_RETRY_EXC_TYPES = {
 
 
 class ExecutorPool:
-    """Round-robin scheduler over executor actor handles with retry.
+    """Straggler-resistant scheduler over executor actor handles with retry.
+
+    Dispatch is **least-loaded**: each executor carries its own in-flight
+    counter capped at ``max_inflight_per_executor`` (the old single global
+    ``4 × pool`` cap let every task stack on one slow executor while its
+    siblings idled); ties rotate round-robin, and a task's preferred
+    (cache-local) executor is honored on every attempt — retries included —
+    unless it is marked down or its queue is at cap, in which case the task
+    hands off to the least-busy live executor instead of stacking.
 
     Retry parity: the reference's fetch tasks run with ``max_retries=-1``
     (dataset.py:54) and executor actors revive with ``maxRestarts=-1``; we retry a
@@ -237,6 +289,13 @@ class ExecutorPool:
         self.executors = list(executors)
         self.by_name = {h.name: h for h in executors}
         self.max_task_retries = max_task_retries
+        #: stable per-handle identity, index-aligned with ``executors`` —
+        #: in-flight counters and the down map key on THIS, never on
+        #: ``handle.name``: several unnamed executors would alias one ""
+        #: entry, so one crash would mark them all down
+        self._idents = [self._executor_ident(h) for h in self.executors]
+        self._ident_of = {id(h): ident
+                          for h, ident in zip(self.executors, self._idents)}
         #: executor name → data-plane host id (machine), for locality routing
         self.hosts_by_name: Dict[str, str] = dict(hosts_by_name or {})
         self._names_by_host: Dict[str, List[str]] = {}
@@ -247,6 +306,16 @@ class ExecutorPool:
         self._rr = 0
         self._local_rr: Dict[str, int] = {}
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _executor_ident(h) -> str:
+        """Stable scheduling identity of a handle: the actor id when it has
+        one, else the name, else the handle object itself (an anonymous
+        stub in tests) — never a shared sentinel like ""."""
+        aid = getattr(h, "actor_id", None)
+        if aid:
+            return str(aid)
+        return h.name or f"anon-{id(h):x}"
 
     def _next_executor(self) -> ActorHandle:
         with self._lock:
@@ -276,65 +345,152 @@ class ExecutorPool:
         preferred: Optional[Sequence[Optional[str]]] = None,
         max_inflight_per_executor: int = 4,
         payloads: Optional[Sequence[bytes]] = None,
+        sched_stats: Optional[Dict[str, Any]] = None,
     ) -> List[Dict[str, Any]]:
         """Run tasks, preserving order of results; blocks until all complete.
+
+        Dispatch is least-loaded with per-executor in-flight caps (see the
+        class docstring). Once the stage is past a completion quantile
+        (``RDT_SPECULATION_QUANTILE``) and a pending attempt's runtime
+        exceeds ``RDT_SPECULATION_MULTIPLIER`` × the completed-task median
+        (floored by ``RDT_SPECULATION_MIN_S``), a **speculative backup** of
+        the same serialized payload is submitted to a different live
+        executor; the first finisher wins and the loser's outputs are
+        drained and freed through the late-result path — byte-identical
+        reruns make either copy's bytes valid, but each attempt writes its
+        own store blobs, so only the winner's refs reach the caller (and
+        through it the lineage ledger). ``RDT_SPECULATION=0`` disables
+        backups.
 
         Failed attempts resubmit after exponential backoff with full jitter
         (never the old immediate hot loop). A task that read a LOST store
         blob fails the stage at once as :class:`ObjectsLostError` — retrying
         the consumer replays the miss; only lineage recovery (the engine's
         job) can fix it. Any stage abort first cancels queued retries, drains
-        in-flight tasks, and frees the outputs the caller will never see."""
+        in-flight tasks, and frees the outputs the caller will never see.
+
+        ``sched_stats``, when given, is updated in place with
+        ``speculated`` / ``speculation_won`` counters and a
+        ``per_executor_busy`` map (executor display name → peak in-flight
+        during this call), merging across calls."""
         n = len(tasks)
         results: List[Optional[Dict[str, Any]]] = [None] * n
         attempts = [0] * n
-        max_inflight = max(1, max_inflight_per_executor * len(self.executors))
-        pending: Dict[Any, Tuple[int, str]] = {}
+        cap = max(1, max_inflight_per_executor)
+        pending: Dict[Any, _Attempt] = {}
+        inflight: Dict[str, int] = {ident: 0 for ident in self._idents}
+        busy_peak: Dict[str, int] = {}
+        copies = [0] * n             # live in-flight attempts per task
         retry_q: List[Tuple[float, int]] = []  # (due monotonic, task index)
         rng = random.Random()
         next_idx = 0
+        done_cnt = 0
+        durations: List[float] = []  # winning-attempt runtimes, for the median
+        speculated: set = set()      # task indices that got a backup
+        spec_won = 0
+        spec_on = _speculation_enabled() and len(self.executors) > 1
+        spec_gate = max(1, math.ceil(_speculation_quantile() * n))
+        spec_mult = _speculation_multiplier()
+        spec_min_s = _speculation_min_s()
         # serialize each task at most once (caller-provided payloads — e.g.
-        # the engine's lineage ledger copies — are reused; retries too)
+        # the engine's lineage ledger copies — are reused; retries and
+        # speculative backups reuse the same bytes too)
         blobs: List[Optional[bytes]] = list(payloads) if payloads is not None \
             else [None] * n
 
-        down: Dict[str, float] = {}  # name -> monotonic time marked down
+        down: Dict[str, float] = {}  # ident -> monotonic time marked down
         uprobe = [0] * n             # unreachable-submit probes per task
         unreach_since: List[Optional[float]] = [None] * n
 
-        def _is_down(ename: str) -> bool:
-            t = down.get(ename)
+        def _is_down(ident: str) -> bool:
+            t = down.get(ident)
             return t is not None and time.monotonic() - t < _DOWN_TTL_S
 
+        def _any_capacity() -> bool:
+            any_live = live_free = False
+            for ident in self._idents:
+                if not _is_down(ident):
+                    any_live = True
+                    if inflight[ident] < cap:
+                        live_free = True
+            if any_live:
+                # a live executor at cap is BUSY, not gone: tasks wait for a
+                # slot instead of probing a dead address (which would burn
+                # their unreachable grace while the cluster is healthy)
+                return live_free
+            # every executor is down: free slots on them count — probing is
+            # the only way to notice a restart (the down TTL expires and the
+            # submit itself is the probe)
+            return any(inflight[ident] < cap for ident in self._idents)
+
+        def _choose(i: int, exclude: Optional[str] = None,
+                    probe: bool = True):
+            """(handle, ident) to run task ``i`` on: the preferred executor
+            whenever it is live and below its cap — on EVERY attempt, so a
+            transient failure no longer strands a cache-local task on remote
+            hosts for the rest of its retries — else the least-loaded live
+            executor below cap (round-robin tiebreak). When every executor
+            is down, a second pass (``probe=True``) returns a
+            down-but-below-cap executor so the submit itself probes for a
+            restart — but ONLY then: a live executor at its cap means the
+            task should wait for a slot, not accrue unreachable grace
+            against a dead address while the pool is merely busy;
+            (None, None) = nothing to submit to right now."""
+            if preferred is not None and preferred[i] is not None:
+                h = self.by_name.get(preferred[i])
+                if h is not None:
+                    ident = self._ident_of[id(h)]
+                    if ident != exclude and not _is_down(ident) \
+                            and inflight[ident] < cap:
+                        return h, ident
+            k = len(self.executors)
+            with self._lock:
+                start = self._rr
+                self._rr += 1
+            may_probe = probe and not any(not _is_down(ident)
+                                          for ident in self._idents)
+            best = None
+            for allow_down in (False, True) if may_probe else (False,):
+                for off in range(k):
+                    j = (start + off) % k
+                    ident = self._idents[j]
+                    if ident == exclude or inflight[ident] >= cap:
+                        continue
+                    if _is_down(ident) != allow_down:
+                        continue
+                    if best is None or inflight[ident] < best[2]:
+                        best = (self.executors[j], ident, inflight[ident])
+                if best is not None:
+                    break
+            if best is None:
+                return None, None
+            return best[0], best[1]
+
+        def _register(fut, i: int, ident: str, name: str, backup: bool):
+            pending[fut] = _Attempt(i, ident, name, time.monotonic(), backup)
+            inflight[ident] += 1
+            copies[i] += 1
+            busy_peak[name] = max(busy_peak.get(name, 0), inflight[ident])
+
         def _submit(i: int):
-            name = None
-            if preferred is not None and preferred[i] is not None \
-                    and attempts[i] == 0:
-                name = preferred[i]
-            handle = self.by_name.get(name) if name else None
-            if handle is None or _is_down(handle.name or ""):
-                # rotate past executors recently seen unreachable: a task
-                # whose preferred executor died must land on a live one (a
-                # lost cache block rebuilds from its lineage recipe there)
-                handle = self._next_executor()
-                for _ in range(len(self.executors)):
-                    if not _is_down(handle.name or ""):
-                        break
-                    handle = self._next_executor()
+            handle, ident = _choose(i)
+            if handle is None:
+                # every queue is at cap (a race leftover — callers check
+                # capacity first): try again shortly
+                heapq.heappush(retry_q, (time.monotonic() + 0.05, i))
+                return
             if blobs[i] is None:
                 blobs[i] = cloudpickle.dumps(tasks[i])
-            payload = blobs[i]
             try:
-                fut = handle.submit("run_task", payload)
+                fut = handle.submit("run_task", blobs[i])
             except (ConnectionLost, OSError) as e:
                 # a crashed executor's address refuses connections until the
                 # supervisor re-homes it — and a restart is a process spawn
                 # plus the jax import storm, tens of seconds under load. That
                 # must not burn the task-retry budget: mark the executor
                 # down, rotate, and keep probing within a wall-clock grace.
-                hname = handle.name or ""
                 now = time.monotonic()
-                down[hname] = now
+                down[ident] = now
                 if unreach_since[i] is None:
                     unreach_since[i] = now
                 uprobe[i] += 1
@@ -346,49 +502,123 @@ class ExecutorPool:
                 delay = _backoff_delay(uprobe[i], rng)
                 logger.warning("submit of task %s to %s failed (probe %d, "
                                "retry in %.2fs): %s", tasks[i].task_id,
-                               hname, uprobe[i], delay, e)
+                               handle.name or ident, uprobe[i], delay, e)
                 heapq.heappush(retry_q, (now + delay, i))
                 return
             unreach_since[i] = None
             uprobe[i] = 0
-            pending[fut] = (i, handle.name or "")
+            _register(fut, i, ident, handle.name or ident, False)
+
+        def _maybe_speculate(now: float) -> Optional[float]:
+            """Submit backups for straggling attempts; return seconds until
+            the next attempt becomes eligible (None = nothing to watch)."""
+            if not spec_on or done_cnt < spec_gate or done_cnt >= n \
+                    or not durations:
+                return None
+            med = sorted(durations)[len(durations) // 2]
+            threshold = max(spec_mult * med, spec_min_s)
+            next_due = None
+            for at in list(pending.values()):
+                i = at.i
+                if at.backup or results[i] is not None or i in speculated \
+                        or blobs[i] is None:
+                    continue
+                age = now - at.started
+                if age < threshold:
+                    due = threshold - age
+                    next_due = due if next_due is None else min(next_due, due)
+                    continue
+                handle, ident = _choose(i, exclude=at.ident, probe=False)
+                if handle is None:
+                    continue  # no DISTINCT live executor below cap right now
+                try:
+                    bfut = handle.submit("run_task", blobs[i])
+                except (ConnectionLost, OSError):
+                    down[ident] = time.monotonic()
+                    continue
+                speculated.add(i)
+                _register(bfut, i, ident, handle.name or ident, True)
+                with profiler.trace("speculate:submit", "etl",
+                                    task_id=tasks[i].task_id,
+                                    to=handle.name or ident,
+                                    after_s=round(age, 3)):
+                    pass
+                logger.info("speculative backup of task %s submitted to %s "
+                            "after %.2fs (median %.2fs)", tasks[i].task_id,
+                            handle.name or ident, age, med)
+            return next_due
 
         try:
-            while next_idx < n and len(pending) < max_inflight:
+            while next_idx < n and _any_capacity():
                 _submit(next_idx)
                 next_idx += 1
 
-            while pending or retry_q:
+            while done_cnt < n:
                 now = time.monotonic()
-                while retry_q and retry_q[0][0] <= now \
-                        and len(pending) < max_inflight:
+                while retry_q and retry_q[0][0] <= now and _any_capacity():
                     _, i = heapq.heappop(retry_q)
-                    _submit(i)
+                    if results[i] is None:
+                        _submit(i)  # a backup may have won while it waited
+                spec_due = _maybe_speculate(time.monotonic())
                 if not pending:
                     if retry_q:
                         time.sleep(max(0.0, min(
                             retry_q[0][0] - time.monotonic(),
                             _RETRY_BACKOFF_CAP_S)))
                         continue
+                    if next_idx < n:
+                        _submit(next_idx)
+                        next_idx += 1
+                        continue
                     break
                 # a due retry only shortens the wait when a slot is free to
                 # take it — otherwise timeout=0 would busy-spin against a
-                # full pool until some in-flight task completes
+                # full pool until some in-flight task completes; a pending
+                # speculation deadline shortens it likewise
                 timeout = max(0.0, retry_q[0][0] - time.monotonic()) \
-                    if retry_q and len(pending) < max_inflight else None
+                    if retry_q and _any_capacity() else None
+                if spec_due is not None:
+                    timeout = spec_due if timeout is None \
+                        else min(timeout, spec_due)
                 done, _ = wait(list(pending.keys()), timeout=timeout,
                                return_when=FIRST_COMPLETED)
                 for fut in done:
-                    i, ename = pending.pop(fut)
+                    at = pending.pop(fut)
+                    i = at.i
+                    inflight[at.ident] -= 1
+                    copies[i] -= 1
                     err = fut.exception()
-                    if err is None:
-                        results[i] = fut.result()
+                    if results[i] is not None:
+                        # a duplicate of an already-decided task: the
+                        # speculation loser — drain it, free its outputs
+                        if err is None:
+                            self._free_loser_result(fut, results[i])
+                        elif isinstance(err, ConnectionLost):
+                            down[at.ident] = time.monotonic()
                         continue
-                    if isinstance(err, ConnectionLost) and ename:
+                    if err is None:
+                        r = fut.result()
+                        results[i] = r
+                        done_cnt += 1
+                        durations.append(time.monotonic() - at.started)
+                        if i in speculated:
+                            r["_speculated"] = 1
+                            if at.backup:
+                                spec_won += 1
+                                r["_speculation_won"] = 1
+                                with profiler.trace(
+                                        "speculate:win", "etl",
+                                        task_id=tasks[i].task_id,
+                                        on=at.name):
+                                    pass
+                                logger.info(
+                                    "speculative backup of task %s won on "
+                                    "%s", tasks[i].task_id, at.name)
+                        continue
+                    if isinstance(err, ConnectionLost) and at.ident:
                         # the executor died mid-task: steer the resubmit (and
                         # every sibling) away from it while it restarts
-                        down[ename] = time.monotonic()
-                    attempts[i] += 1
+                        down[at.ident] = time.monotonic()
                     if isinstance(err, RemoteError) \
                             and err.exc_type == "ObjectLostError":
                         lost = _lost_ids_of(err)
@@ -399,6 +629,15 @@ class ExecutorPool:
                             and err.exc_type in _NO_RETRY_EXC_TYPES):
                         raise StageError(
                             f"task {tasks[i].task_id} failed: {err}") from err
+                    attempts[i] += 1
+                    if copies[i] > 0:
+                        # a sibling copy of this task is still in flight —
+                        # it IS the retry; queuing another would triple-run
+                        logger.warning(
+                            "task %s attempt failed on %s; its speculative "
+                            "sibling is still running", tasks[i].task_id,
+                            at.name)
+                        continue
                     if attempts[i] > self.max_task_retries:
                         raise StageError(
                             f"task {tasks[i].task_id} failed after "
@@ -406,10 +645,10 @@ class ExecutorPool:
                     delay = _backoff_delay(attempts[i], rng)
                     logger.warning(
                         "task %s failed on %s (attempt %d, retry in %.2fs): %s",
-                        tasks[i].task_id, ename, attempts[i], delay,
+                        tasks[i].task_id, at.name, attempts[i], delay,
                         str(err).splitlines()[0] if str(err) else err)
                     heapq.heappush(retry_q, (time.monotonic() + delay, i))
-                while next_idx < n and len(pending) < max_inflight:
+                while next_idx < n and _any_capacity():
                     _submit(next_idx)
                     next_idx += 1
         except ObjectsLostError as e:
@@ -428,16 +667,36 @@ class ExecutorPool:
             # cancel queued retries, drain in-flight tasks, free outputs
             self._abort_stage(pending, results, retry_q)
             raise
+        # every task is decided; losing duplicates may still be running —
+        # do NOT wait for them (that would hand the straggler back its
+        # hostage). Whenever each one lands, its outputs are freed and a
+        # late cache-put dropped through the loser path.
+        for fut, at in list(pending.items()):
+            winner = results[at.i]
+            fut.add_done_callback(
+                lambda f, w=winner: self._free_loser_result(f, w))
+        pending.clear()
+        if sched_stats is not None:
+            sched_stats["speculated"] = \
+                sched_stats.get("speculated", 0) + len(speculated)
+            sched_stats["speculation_won"] = \
+                sched_stats.get("speculation_won", 0) + spec_won
+            peb = sched_stats.setdefault("per_executor_busy", {})
+            for name, peak in busy_peak.items():
+                peb[name] = max(peb.get(name, 0), peak)
         return results  # type: ignore[return-value]
 
-    def _drain_merge(self, pending: Dict[Any, Tuple[int, str]],
+    def _drain_merge(self, pending: Dict[Any, "_Attempt"],
                      results: List[Optional[Dict[str, Any]]],
                      retry_q: List[Tuple[float, int]]) -> List[str]:
         """Stage abort: cancel queued resubmits and drain in-flight tasks
         KEEPING whatever completed — unlike :meth:`_abort_stage`, nothing is
         freed, because the caller either resubmits around these results or
-        frees them itself when recovery gives up. Returns lost object ids
-        harvested from tasks that failed lost-blob during the drain."""
+        frees them itself when recovery gives up. Speculation duplicates of
+        tasks that already have a result are the exception: their outputs
+        reach no caller, so they free here (the winner's refs are what the
+        caller keeps). Returns lost object ids harvested from tasks that
+        failed lost-blob during the drain."""
         retry_q.clear()
         lost: List[str] = []
         if not pending:
@@ -454,10 +713,13 @@ class ExecutorPool:
                 # else would ever release it
                 fut.add_done_callback(self._free_late_result)
         for fut in done:
-            i, _ = pending[fut]
+            at = pending[fut]
             err = fut.exception()
             if err is None:
-                results[i] = fut.result()
+                if results[at.i] is None:
+                    results[at.i] = fut.result()
+                else:
+                    self._free_loser_result(fut, results[at.i])
             elif isinstance(err, RemoteError) \
                     and err.exc_type == "ObjectLostError":
                 lost.extend(_lost_ids_of(err))
@@ -469,34 +731,53 @@ class ExecutorPool:
         free its store outputs, and drop a late-cached block from its
         executor — the block landed AFTER the aborting action's prefix sweep
         ran, and each persist() uses a fresh frame id, so no later sweep
-        would ever target it (it would pin executor RAM forever).
+        would ever target it (it would pin executor RAM forever)."""
+        self._free_loser_result(fut, None)
 
-        The work runs on a throwaway daemon thread: this callback fires on
-        the executor connection's RPC read loop, and ``drop_blocks`` is a
-        synchronous call over that same connection — issued inline it would
-        block the only thread able to deliver its own response, wedging the
-        connection for every later task on that executor."""
-        threading.Thread(target=self._free_late_result_sync, args=(fut,),
-                         daemon=True, name="rdt-free-late-result").start()
+    def _free_loser_result(self, fut, winner: Optional[Dict[str, Any]]
+                           ) -> None:
+        """Free the outputs of a task attempt whose result reaches no caller
+        — a speculation loser, or a drain-abandoned straggler landing late.
 
-    def _free_late_result_sync(self, fut) -> None:
+        The work runs on a throwaway daemon thread: this may fire as a
+        Future done-callback on the executor connection's RPC read loop, and
+        ``drop_blocks`` is a synchronous call over that same connection —
+        issued inline it would block the only thread able to deliver its own
+        response, wedging the connection for every later task on that
+        executor."""
+        threading.Thread(target=self._free_loser_result_sync,
+                         args=(fut, winner), daemon=True,
+                         name="rdt-free-late-result").start()
+
+    def _free_loser_result_sync(self, fut,
+                                winner: Optional[Dict[str, Any]]) -> None:
         try:
             err = fut.exception()
-            if err is None:
-                res = fut.result()
-                _free_result_refs([res])
-                key = res.get("cache_key")
-                if key is not None:
-                    h = self.by_name.get(res.get("executor"))
-                    if h is not None:
-                        # stamp-conditioned: a lineage-recovery resubmit of
-                        # this same task may have re-cached the key on this
-                        # executor; only OUR stale generation must go
-                        h.drop_blocks([key], res.get("cache_stamp"))
+            if err is not None:
+                return  # a failed loser wrote nothing that survived
+            res = fut.result()
+            _free_result_refs([res])
+            key = res.get("cache_key")
+            if key is None:
+                return
+            if winner is not None and winner.get("cache_key") == key \
+                    and winner.get("executor") == res.get("executor") \
+                    and winner.get("cache_stamp") == res.get("cache_stamp"):
+                # both copies ran on ONE executor and the duplicate
+                # cache-put was idempotent (BlockCache.put_once returned
+                # the first put's stamp): the loser's entry IS the block
+                # the winner's CachedScan references — leave it alone
+                return
+            h = self.by_name.get(res.get("executor"))
+            if h is not None:
+                # stamp-conditioned: a lineage-recovery resubmit of
+                # this same task may have re-cached the key on this
+                # executor; only OUR stale generation must go
+                h.drop_blocks([key], res.get("cache_stamp"))
         except Exception:
             pass  # store/executor may already be shut down; nothing to salvage
 
-    def _abort_stage(self, pending: Dict[Any, Tuple[int, str]],
+    def _abort_stage(self, pending: Dict[Any, "_Attempt"],
                      results: List[Optional[Dict[str, Any]]],
                      retry_q: List[Tuple[float, int]]) -> None:
         """The stage is failing: cancel queued resubmits, wait out tasks that
@@ -528,7 +809,8 @@ class Engine:
     # ---- shuffle accounting -------------------------------------------------
     def _record_stage(self, label: str, results: Sequence[Dict[str, Any]],
                       num_buckets: int,
-                      temps: Optional[List[ObjectRef]] = None) -> None:
+                      temps: Optional[List[ObjectRef]] = None,
+                      sched_stats: Optional[Dict[str, Any]] = None) -> None:
         """Aggregate map-task shuffle counters into one stage entry and emit
         a driver-side trace span carrying the totals as args."""
         rows = sum(int(r.get("num_rows", 0)) for r in results)
@@ -550,6 +832,17 @@ class Engine:
                                    for r in results),
                  "consolidated": any(r.get("consolidated_ref") is not None
                                      for r in results),
+                 # straggler-scheduler accounting: tasks that got a
+                 # speculative backup / whose backup won (driver-side
+                 # annotations on the winning results — reduce-task
+                 # speculation folds in later via Task.consumes_stage), and
+                 # the per-executor peak in-flight depth of the MAP stage
+                 "speculated": sum(int(r.get("_speculated", 0))
+                                   for r in results),
+                 "speculation_won": sum(int(r.get("_speculation_won", 0))
+                                        for r in results),
+                 "per_executor_busy": dict(
+                     (sched_stats or {}).get("per_executor_busy") or {}),
                  # lineage-recovery accounting: blobs regenerated for this
                  # stage's intermediates, and how many recovery events ran
                  "regenerated": 0, "recovered": 0}
@@ -583,7 +876,12 @@ class Engine:
         reduce tasks' reads — an upper bound when tasks overlap on one
         executor (they share process counters); the exact session totals are
         ``ObjectStoreServer.op_counts()``. ``consolidated`` marks the
-        single-blob map output format. ``regenerated`` counts intermediate blobs rebuilt
+        single-blob map output format. ``speculated``/``speculation_won``
+        count tasks that got a speculative backup and tasks whose backup
+        finished first (map tasks plus the stage's reduce-side consumers;
+        0/0 on a straggler-free run); ``per_executor_busy`` maps executor
+        name → the peak in-flight task depth the least-loaded dispatcher
+        drove it to during the map stage. ``regenerated`` counts intermediate blobs rebuilt
         through lineage recovery after a store loss, ``recovered`` the
         recovery events that rebuilt them (0/0 on a fault-free run)."""
         with self._report_lock:
@@ -608,6 +906,8 @@ class Engine:
                          "rows_in": 0, "bytes_in": 0, "rows_shuffled": 0,
                          "bytes_shuffled": 0, "meta_rpcs": 0,
                          "fetch_rpcs": 0, "consolidated": False,
+                         "speculated": 0, "speculation_won": 0,
+                         "per_executor_busy": {},
                          "regenerated": 0, "recovered": 0}
                 self._stage_reports.append(entry)
                 temps.stage_entries[prod.label] = entry
@@ -709,6 +1009,7 @@ class Engine:
                    preferred: Optional[Sequence[Optional[str]]] = None,
                    temps: Optional[List[ObjectRef]] = None,
                    lineage_label: Optional[str] = None,
+                   sched_stats: Optional[Dict[str, Any]] = None,
                    _depth: int = 0) -> List[Dict[str, Any]]:
         """``pool.run_tasks`` with lineage recovery: on a lost-blob failure,
         re-execute the producers of the lost intermediates (transitively,
@@ -744,7 +1045,8 @@ class Engine:
                     out = self.pool.run_tasks(
                         [tasks[i] for i in todo], sub_pref,
                         payloads=[blobs[i] for i in todo]
-                        if blobs is not None else None)
+                        if blobs is not None else None,
+                        sched_stats=sched_stats)
                     for i, r in zip(todo, out):
                         results[i] = r
                     if lineage_label is not None:
@@ -807,6 +1109,11 @@ class Engine:
                 if entry is not None:
                     entry["meta_rpcs"] += int(r.get("meta_rpcs", 0))
                     entry["fetch_rpcs"] += int(r.get("fetch_rpcs", 0))
+                    # reduce-side speculation lands on the stage the task
+                    # consumed, same attribution as its store RPCs
+                    entry["speculated"] += int(r.get("_speculated", 0))
+                    entry["speculation_won"] += \
+                        int(r.get("_speculation_won", 0))
 
     @staticmethod
     def _expand_lost(lost_ids: Sequence[str], tasks: Sequence[T.Task],
@@ -1043,10 +1350,12 @@ class Engine:
                              owner=self.owner)
                 for i, r in enumerate(refs)
             ]
+            sstats: Dict[str, Any] = {}
             results = self._run_stage(
                 map_tasks, self._locality([[r] for r in refs]), temps,
-                lineage_label="random-shuffle")
-            self._record_stage("random-shuffle", results, nb, temps)
+                lineage_label="random-shuffle", sched_stats=sstats)
+            self._record_stage("random-shuffle", results, nb, temps,
+                               sched_stats=sstats)
             buckets = self._gather_buckets(results, nb, temps)
             reduce_tasks = [
                 self._bucket_task(bucket, schema_bytes,
@@ -1264,8 +1573,11 @@ class Engine:
                                shuffle_consolidate=_consolidate_enabled(),
                                owner=self.owner)
                  for t in tasks]
-        results = self._run_stage(tasks, preferred, temps, lineage_label=label)
-        self._record_stage(label, results, num_buckets, temps)
+        sstats: Dict[str, Any] = {}
+        results = self._run_stage(tasks, preferred, temps, lineage_label=label,
+                                  sched_stats=sstats)
+        self._record_stage(label, results, num_buckets, temps,
+                           sched_stats=sstats)
         schema = results[0]["schema"] if results else None
         return self._gather_buckets(results, num_buckets, temps), schema
 
@@ -1400,9 +1712,12 @@ class Engine:
                 owner=self.owner)
             for ref in refs
         ]
+        sstats: Dict[str, Any] = {}
         results = self._run_stage(shuffle_tasks, None, temps,
-                                  lineage_label="sort-range")
-        self._record_stage("sort-range", results, len(boundaries) + 1, temps)
+                                  lineage_label="sort-range",
+                                  sched_stats=sstats)
+        self._record_stage("sort-range", results, len(boundaries) + 1, temps,
+                           sched_stats=sstats)
         buckets = self._gather_buckets(results, len(boundaries) + 1, temps)
         # buckets come out in global sort order for any direction mix (the
         # composite comparison honors per-key direction; nulls sort last)

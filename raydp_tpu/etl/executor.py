@@ -50,6 +50,24 @@ class BlockCache:
             self._blocks[key] = table
             self._stamps[key] = stamp
 
+    def put_once(self, key: str, table: pa.Table,
+                 stamp: Optional[str] = None) -> Optional[str]:
+        """Idempotent cache-put for duplicate task attempts (speculative
+        backups, recovery resubmits racing a drain-abandoned straggler): if
+        the key is already cached, keep the existing entry and return ITS
+        stamp — tasks are deterministic recipes, so two attempts' tables are
+        byte-identical, and sharing one entry + stamp lets the driver's
+        loser drain recognize "the loser's block IS the winner's block" and
+        skip the drop. Worst case (the first writer's deferred drop fires
+        later) the block vanishes and the next read rebuilds it from its
+        lineage recipe — never wrong data, never a pinned stale table."""
+        with self._lock:
+            if key in self._blocks:
+                return self._stamps.get(key)
+            self._blocks[key] = table
+            self._stamps[key] = stamp
+            return stamp
+
     def drop(self, keys: List[str], if_stamp: Optional[str] = None) -> int:
         with self._lock:
             n = 0
@@ -131,7 +149,11 @@ class EtlExecutor:
         from raydp_tpu import profiler
 
         task: T.Task = cloudpickle.loads(task_bytes)
-        rule = faults.check("executor.run_task", key=task.task_id)
+        # the fault key carries the executor name so a chaos schedule can
+        # target ONE executor (`match=<executor name>|` = a seeded straggler
+        # or crashy node) as well as one task (`match=<task id>`)
+        rule = faults.check("executor.run_task",
+                            key=f"{self._actor_name}|{task.task_id}")
         if rule is not None:
             faults.apply(rule, "executor.run_task")
         client = get_client()
@@ -180,8 +202,11 @@ class EtlExecutor:
 
         if task.output == T.CACHE:
             assert task.cache_key is not None
-            stamp = uuid.uuid4().hex
-            self.cache.put(task.cache_key, table, stamp)
+            # put_once: a speculative duplicate of this task may have cached
+            # the key already — both attempts then report the SAME stamp, so
+            # the driver's loser drain knows the entries coincide
+            stamp = self.cache.put_once(task.cache_key, table,
+                                        uuid.uuid4().hex)
             return _with_rpcs({
                 "num_rows": table.num_rows,
                 "nbytes": table.nbytes,

@@ -28,6 +28,12 @@ Example — crash the executor on its 3rd task, exactly once in the session::
 
     RDT_FAULTS="executor.run_task:crash:nth=3:once=/tmp/crash.sentinel"
 
+The ``executor.run_task`` key is ``"<executor name>|<task id>"``, so
+``match=`` can pin a rule to ONE executor — the seeded-straggler schedule
+the speculation bench uses (delay every task entering a single executor)::
+
+    RDT_FAULTS="executor.run_task:delay:ms=1500:match=rdt-executor-app-0|"
+
 This module must stay importable everywhere (actor bootstrap, rank workers,
 the RPC client): stdlib only, no raydp_tpu imports.
 """

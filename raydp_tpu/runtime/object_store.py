@@ -1458,7 +1458,15 @@ class ObjectStoreClient:
 
     # -- lifetime -------------------------------------------------------------
     def free(self, refs: List[ObjectRef]) -> int:
-        ids = [r.id for r in refs]
+        """Release blobs; idempotent and duplicate-tolerant — a speculation
+        loser's outputs can reach free() from the late-result drain AND a
+        stage-abort sweep, and the store-count audits (chaos tests) rely on
+        a double free never going negative or erroring. The server pop
+        already ignores unknown ids; ids are deduped here so a batch with
+        repeats evicts local memo/segment state exactly once."""
+        ids = list(dict.fromkeys(r.id for r in refs))
+        if not ids:
+            return 0
         for oid in ids:
             self._evict(oid)
         self.meta_rpc_count += 1

@@ -139,6 +139,55 @@ def test_dropped_consolidated_map_blob_recovery(tmp_path, monkeypatch):
     assert sum(e.get("regenerated", 0) for e in report) >= 1, report
 
 
+def test_straggler_speculation_composes_with_lineage_recovery(tmp_path,
+                                                              monkeypatch):
+    """A seeded one-executor straggler (every task entering executor 0 sleeps
+    at entry) COMBINED with a dropped shuffle blob in the same action:
+    speculative backup tasks and lineage recovery must compose — results
+    byte-identical to the fault-free run, the drop recovered through the
+    ledger, at least one backup fired, and the store object count back at
+    its pre-action value (no orphans from won/lost speculation races; the
+    losers land late and free through the late-result path, so the audit
+    polls). The drop is pinned to nth=1: the fast executor's first map
+    write, deterministically a WINNING attempt's blob — the delayed
+    executor's first write trails it by the full injected delay."""
+    from raydp_tpu.runtime.object_store import get_client
+
+    base, _, _ = _run_groupagg("chaos-straggler-base")
+
+    sent = str(tmp_path / "straggler-drop.sentinel")
+    victim = "rdt-executor-chaos-straggler-0"
+    monkeypatch.setenv(
+        "RDT_FAULTS",
+        f"executor.run_task:delay:ms=600:match={victim}|;"
+        f"shuffle.write:drop:nth=1:once={sent}")
+    monkeypatch.setenv("RDT_SPECULATION_QUANTILE", "0.25")
+    monkeypatch.setenv("RDT_SPECULATION_MIN_S", "0.15")
+    s = _session("chaos-straggler")
+    try:
+        client = get_client()
+        df = _frame(s)
+        before = client.stats()["num_objects"]
+        out = df.groupBy("k").agg(F.sum("v").alias("s"),
+                                  F.count("v").alias("n"))
+        table = s.engine.collect(out._plan).sort_by([("k", "ascending")])
+        report = s.engine.shuffle_stage_report()
+        assert os.path.exists(sent), "injected drop never fired"
+        assert _ipc_bytes(table) == base
+        assert sum(e.get("recovered", 0) for e in report) >= 1, report
+        assert sum(e.get("regenerated", 0) for e in report) >= 1, report
+        assert sum(e.get("speculated", 0) for e in report) >= 1, report
+        deadline = time.time() + 30
+        while time.time() < deadline \
+                and client.stats()["num_objects"] != before:
+            time.sleep(0.25)
+        after = client.stats()["num_objects"]
+        assert after == before, (
+            f"speculation races orphaned {after - before} store objects")
+    finally:
+        raydp_tpu.stop()
+
+
 def test_dropped_bucket_without_recovery_raises_stage_error(tmp_path,
                                                             monkeypatch):
     """Same drop schedule with lineage recovery disabled: the action must

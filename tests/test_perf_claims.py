@@ -139,7 +139,8 @@ _MEASURED_RE = re.compile(
     r"measured(?:[^.\n]|\n(?!\n)){0,100}?"
     r"([0-9][\d,.]*\s*(?:k|M)?\s*(?:%?\s*MFU|tok/s|tokens/s"
     r"|samples/s(?:/chip)?|ms/step|×\s*fewer\s+shuffled\s+bytes"
-    r"|×\s*fewer\s+store\s+metadata\s+RPCs))",
+    r"|×\s*fewer\s+store\s+metadata\s+RPCs"
+    r"|×\s*faster\s+stage\s+wall))",
     re.I)
 
 

@@ -1,0 +1,292 @@
+"""Straggler-proof stage scheduling units (ISSUE 5): least-loaded dispatch
+with per-executor in-flight caps, locality-preserving retries, per-handle
+down tracking, and speculative backup tasks — first finisher wins, the
+loser's outputs drain through the late-result path.
+
+These run against stub executor handles (no runtime), so they pin the
+DRIVER-side scheduling contract; the end-to-end composition with real
+executors and the fault plane lives in tests/test_chaos.py and the
+``--straggler`` leg of benchmarks/shuffle_bench.py.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+from types import SimpleNamespace
+
+import pyarrow as pa
+
+from raydp_tpu.etl import engine as E
+from raydp_tpu.etl.engine import ExecutorPool
+from raydp_tpu.runtime.object_store import ObjectRef
+from raydp_tpu.runtime.rpc import ConnectionLost, RemoteError
+
+
+class StubExecutor:
+    """Actor-handle stand-in: ``submit`` returns a Future a timer resolves
+    after ``latency`` seconds. ``script`` overrides per call, in order: the
+    string ``"connlost"`` raises on submit; ``(delay, fn)`` runs ``fn(fut)``
+    after ``delay`` (fn=None → the default ok result)."""
+
+    def __init__(self, name=None, actor_id=None, latency=0.005):
+        self.name = name
+        if actor_id is not None:
+            self.actor_id = actor_id
+        self.latency = latency
+        self.script = []
+        self.submits = []           # submit timestamps (successful only)
+        self.concurrent = 0
+        self.peak = 0
+        self.dropped = []           # (keys, if_stamp) from drop_blocks
+        self._lock = threading.Lock()
+
+    def submit(self, method, payload):
+        with self._lock:
+            item = self.script.pop(0) if self.script else None
+        if item == "connlost":
+            raise ConnectionLost("submit refused")
+        delay, fn = item if item is not None else (self.latency, None)
+        fut = Future()
+        with self._lock:
+            self.submits.append(time.monotonic())
+            self.concurrent += 1
+            self.peak = max(self.peak, self.concurrent)
+
+        def _finish():
+            with self._lock:
+                self.concurrent -= 1
+            if fn is not None:
+                fn(fut)
+            else:
+                fut.set_result({"num_rows": 1, "executor": self.name})
+
+        threading.Timer(delay, _finish).start()
+        return fut
+
+    def drop_blocks(self, keys, if_stamp=None):
+        self.dropped.append((list(keys), if_stamp))
+
+
+def _tasks(n):
+    return [SimpleNamespace(task_id=f"t{i}") for i in range(n)]
+
+
+def _payloads(n):
+    return [b"payload"] * n
+
+
+def test_per_executor_cap_no_stacking(monkeypatch):
+    """A slow executor's queue must never exceed its own cap while the fast
+    sibling has free slots — the old single global ``4 × pool`` cap let the
+    whole stage stack up behind one straggler."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    slow = StubExecutor(name="slow", latency=0.25)
+    fast = StubExecutor(name="fast", latency=0.005)
+    pool = ExecutorPool([slow, fast])
+    stats = {}
+    out = pool.run_tasks(_tasks(10), max_inflight_per_executor=2,
+                         payloads=_payloads(10), sched_stats=stats)
+    assert all(r is not None for r in out)
+    assert slow.peak <= 2, "slow executor exceeded its per-executor cap"
+    assert stats["per_executor_busy"]["slow"] <= 2
+    # the fast executor absorbed the queue the slow one could not take
+    assert len(fast.submits) >= 6, (len(slow.submits), len(fast.submits))
+
+
+def test_preferred_hands_off_when_at_cap(monkeypatch):
+    """Locality preference is kept — but a preferred executor whose queue is
+    at cap hands the task to the least-loaded live sibling instead of
+    stacking behind itself."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    a = StubExecutor(name="a", latency=0.15)
+    b = StubExecutor(name="b", latency=0.005)
+    pool = ExecutorPool([a, b])
+    out = pool.run_tasks(_tasks(4), preferred=["a"] * 4,
+                         max_inflight_per_executor=1, payloads=_payloads(4))
+    assert all(r is not None for r in out)
+    assert a.peak <= 1
+    assert len(a.submits) >= 1          # preference honored while free
+    assert len(b.submits) >= 2, "tasks stacked on the preferred executor"
+
+
+def test_preferred_honored_when_below_cap(monkeypatch):
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    a = StubExecutor(name="a")
+    b = StubExecutor(name="b")
+    pool = ExecutorPool([a, b])
+    pool.run_tasks(_tasks(4), preferred=["b"] * 4,
+                   max_inflight_per_executor=4, payloads=_payloads(4))
+    assert len(a.submits) == 0
+    assert len(b.submits) == 4
+
+
+def test_retry_keeps_locality(monkeypatch):
+    """Satellite: a transient failure used to strand a cache-local task on
+    round-robin for every later attempt — the retry must return to the
+    preferred executor whenever it is not marked down."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    a = StubExecutor(name="a")
+    a.script = [(0.005, lambda fut: fut.set_exception(
+        RemoteError("RuntimeError", "transient boom", "tb")))]
+    b = StubExecutor(name="b")
+    pool = ExecutorPool([a, b])
+    out = pool.run_tasks(_tasks(1), preferred=["a"], payloads=_payloads(1))
+    assert out[0] is not None
+    assert len(a.submits) == 2, "retry did not return to the preferred executor"
+    assert len(b.submits) == 0
+
+
+def test_down_map_keyed_per_handle_not_by_name(monkeypatch):
+    """Satellite: executors with ``name == None`` used to share one
+    ``down[""]`` entry, so one unnamed executor's crash marked every unnamed
+    executor down. The down map keys on a stable per-handle identity."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    a = StubExecutor(name=None)
+    a.script = ["connlost"] * 8      # permanently unreachable
+    b = StubExecutor(name=None)
+    pool = ExecutorPool([a, b])
+    t0 = time.monotonic()
+    out = pool.run_tasks(_tasks(2), payloads=_payloads(2))
+    wall = time.monotonic() - t0
+    assert all(r is not None for r in out)
+    assert len(b.submits) == 2, "sibling unnamed executor was aliased down"
+    # rotating to the live sibling is immediate — not the unreachable grace
+    assert wall < 5.0, wall
+
+
+def test_busy_pool_with_one_down_executor_waits_not_fails(monkeypatch):
+    """Regression (review finding): when every LIVE executor is at its cap,
+    queued tasks must WAIT for a slot — not probe a down executor's dead
+    address and burn their unreachable grace while the pool is merely busy.
+    With a 1s grace, a down executor, and a live sibling whose backlog
+    exceeds that grace, the stage must still complete."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    monkeypatch.setenv("RDT_EXECUTOR_WAIT_S", "1")
+    dead = StubExecutor(name="dead")
+    dead.script = ["connlost"] * 64
+    live = StubExecutor(name="live", latency=0.3)
+    pool = ExecutorPool([dead, live])
+    out = pool.run_tasks(_tasks(6), max_inflight_per_executor=1,
+                         payloads=_payloads(6))
+    assert all(r is not None for r in out)
+    assert live.peak <= 1
+    assert len(live.submits) == 6
+    # the dead executor saw at most the probes from moments when NO live
+    # executor existed yet (the very first fill, before it was marked down)
+    assert len(dead.script) >= 56, "busy pool kept probing the dead executor"
+
+
+def test_stable_idents_prefer_actor_id():
+    a = StubExecutor(name=None, actor_id="actor-1")
+    b = StubExecutor(name="named")
+    c = StubExecutor(name=None)
+    pool = ExecutorPool([a, b, c])
+    idents = pool._idents
+    assert idents[0] == "actor-1"
+    assert idents[1] == "named"
+    assert idents[2].startswith("anon-")
+    assert len(set(idents)) == 3
+
+
+def test_speculation_backup_wins_and_loser_drained(monkeypatch):
+    """Once the stage is past the completion quantile and an attempt runs
+    past the threshold, a backup of the same payload lands on a DIFFERENT
+    executor; the first finisher wins, the stage does not wait for the
+    straggler, and the loser's store outputs are freed when it lands."""
+    monkeypatch.setenv("RDT_SPECULATION_MIN_S", "0.1")
+    monkeypatch.setenv("RDT_SPECULATION_QUANTILE", "0.5")
+    freed = []
+
+    class _Client:
+        def free(self, refs):
+            freed.extend(r.id for r in refs)
+            return len(refs)
+
+    monkeypatch.setattr(E, "get_client", lambda: _Client())
+
+    loser_ref = ObjectRef(id="d" * 32)
+
+    def slow_result(fut):
+        fut.set_result({"num_rows": 1, "ref": loser_ref, "executor": "slow"})
+
+    slow = StubExecutor(name="slow")
+    slow.script = [(1.5, slow_result)] * 3
+    fast = StubExecutor(name="fast", latency=0.01)
+    pool = ExecutorPool([slow, fast])
+    stats = {}
+    t0 = time.monotonic()
+    out = pool.run_tasks(_tasks(6), payloads=_payloads(6), sched_stats=stats)
+    wall = time.monotonic() - t0
+    assert all(r is not None for r in out)
+    assert wall < 1.2, f"stage waited out the straggler ({wall:.2f}s)"
+    assert stats["speculated"] >= 1
+    assert stats["speculation_won"] >= 1
+    # winner results carry the driver-side annotations the report sums
+    assert sum(int(r.get("_speculation_won", 0)) for r in out) == \
+        stats["speculation_won"]
+    # every backup ran on the OTHER executor (never beside its primary)
+    assert len(fast.submits) >= 3 + stats["speculation_won"]
+    # the losers land at ~1.5s; their blobs free through the late path
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline \
+            and freed.count(loser_ref.id) < stats["speculation_won"]:
+        time.sleep(0.05)
+    assert freed.count(loser_ref.id) >= stats["speculation_won"], freed
+
+
+def test_speculation_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    monkeypatch.setenv("RDT_SPECULATION_MIN_S", "0.05")
+    monkeypatch.setenv("RDT_SPECULATION_QUANTILE", "0.1")
+    slow = StubExecutor(name="slow")
+    slow.script = [(0.6, None)] * 2
+    fast = StubExecutor(name="fast", latency=0.01)
+    pool = ExecutorPool([slow, fast])
+    stats = {}
+    t0 = time.monotonic()
+    out = pool.run_tasks(_tasks(4), payloads=_payloads(4), sched_stats=stats)
+    wall = time.monotonic() - t0
+    assert all(r is not None for r in out)
+    assert stats["speculated"] == 0
+    assert stats["speculation_won"] == 0
+    assert wall >= 0.5, "stage finished before its unspeculated straggler"
+
+
+def test_block_cache_put_once_idempotent():
+    """Executor satellite: a duplicate cache-put (speculative backup of a
+    CACHE task) keeps the existing entry and reports ITS stamp, so both
+    attempts' results name the same generation."""
+    from raydp_tpu.etl.executor import BlockCache
+
+    cache = BlockCache()
+    t = pa.table({"a": [1]})
+    assert cache.put_once("k", t, "s1") == "s1"
+    assert cache.put_once("k", t, "s2") == "s1"   # kept, stamp shared
+    assert cache.drop(["k"], if_stamp="s2") == 0  # the discarded stamp
+    assert cache.drop(["k"], if_stamp="s1") == 1
+    # plain put still overwrites (recovery recache path)
+    cache.put("k", t, "s3")
+    assert cache.put_once("k", t, "s4") == "s3"
+
+
+def test_loser_cache_drop_skipped_when_entry_shared():
+    """When both copies of a CACHE task ran on ONE executor, the idempotent
+    put makes their stamps coincide — the loser drain must then leave the
+    block alone (it IS the winner's block); a loser on a different executor
+    still has its spurious block dropped, stamp-conditioned."""
+    h = StubExecutor(name="e")
+    pool = ExecutorPool.__new__(ExecutorPool)
+    pool.by_name = {"e": h}
+
+    shared = Future()
+    shared.set_result({"cache_key": "k", "cache_stamp": "s", "executor": "e"})
+    winner = {"cache_key": "k", "cache_stamp": "s", "executor": "e"}
+    pool._free_loser_result_sync(shared, winner)
+    assert h.dropped == [], "shared cache entry was dropped under the winner"
+
+    elsewhere = Future()
+    elsewhere.set_result({"cache_key": "k", "cache_stamp": "s2",
+                          "executor": "e"})
+    winner2 = {"cache_key": "k", "cache_stamp": "s1", "executor": "other"}
+    pool._free_loser_result_sync(elsewhere, winner2)
+    assert h.dropped == [(["k"], "s2")]

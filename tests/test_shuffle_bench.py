@@ -39,3 +39,30 @@ def test_shuffle_bench_smoke(tmp_path):
     assert 0 < many["store_rpcs_consolidated"] < many["store_rpcs_naive"]
     assert many["rpc_reduction_x"] >= 3.0
     assert record["all_identical"] is True
+
+
+def test_shuffle_bench_straggler_smoke(tmp_path):
+    """The --straggler leg (benchmarks/STRAGGLER.json harness): a seeded
+    one-executor delay, speculation off vs on. At smoke scale the structural
+    gap is several-x, so the >=1.5x floor has headroom for host noise; the
+    orphan audit pins the won/lost-race contract (every loser blob freed)."""
+    out_path = tmp_path / "STRAGGLER_SMOKE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RDT_STRAGGLER_PATH=str(out_path))
+    for k in ("RDT_FAULTS", "RDT_SPECULATION", "RDT_SPECULATION_QUANTILE",
+              "RDT_SPECULATION_MIN_S", "RDT_SPECULATION_MULTIPLIER"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks", "shuffle_bench.py"),
+         "--straggler", "--smoke"],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    record = json.loads(out_path.read_text())
+    assert record["metric"] == "etl_straggler_speculation" and record["smoke"]
+    cfg = record["configs"]["straggler"]
+    assert cfg["identical"], "speculation changed the action's rows"
+    assert cfg["speculated_on"] >= 1, cfg
+    assert cfg["speculated_off"] == 0, cfg
+    assert cfg["orphans_on"] == 0, (
+        f"speculation races orphaned {cfg['orphans_on']} store objects")
+    assert cfg["speedup_x"] >= 1.5, cfg
