@@ -45,12 +45,24 @@ The straggler record lands in ``benchmarks/STRAGGLER.json`` (override:
 ``RDT_STRAGGLER_PATH``; ``--smoke`` → /tmp/STRAGGLER_SMOKE.json); the
 recorded full-size run measured 9.3× faster stage wall with speculation on.
 
+A fourth leg measures ADAPTIVE EXECUTION (``--aqe`` → ``benchmarks/
+AQE.json``, override ``RDT_AQE_PATH``), each rule off vs on:
+
+- ``broadcast_join`` — the join config's shuffled/broadcast bytes when the
+  small dim side replicates instead of hash-shuffling both sides,
+- ``skew_groupby`` — stage wall on a seeded hot-key groupby (one key ~50%
+  of rows) under a seeded ``shuffle.fetch`` per-MB delay (the slow-data-
+  plane analogue of the straggler leg's seeded delay), split vs not,
+- ``coalesce_many`` — reduce-task dispatch count on the 64×64 config when
+  kilobyte buckets fuse into multi-range reads.
+
 The byte/RPC record lands in ``benchmarks/SHUFFLE_BYTES.json`` (override:
 ``RDT_SHUFFLE_BYTES_PATH``). ``--smoke`` shrinks the data to seconds of
 wall and writes to /tmp by default so a CI smoke run cannot clobber the
-recorded artifact.
+recorded artifact. The optimizer/consolidate/straggler legs pin
+``RDT_ETL_AQE=0`` so each leg measures exactly one mechanism.
 
-Run: python benchmarks/shuffle_bench.py [--smoke] [--straggler]
+Run: python benchmarks/shuffle_bench.py [--smoke] [--straggler] [--aqe]
 """
 
 import json
@@ -75,11 +87,15 @@ def make_frame(session, rows: int, cardinality: int, num_partitions: int):
 
 
 def run_config(session, action, sort_keys):
-    """Run ``action`` with the optimizer off then on; return the record."""
+    """Run ``action`` with the optimizer off then on; return the record.
+    AQE is pinned OFF here: this leg measures the PR-2 plan optimizer, and
+    an adaptive broadcast/coalesce would confound the comparison (the
+    ``--aqe`` leg measures those on their own terms)."""
     from raydp_tpu.etl import optimizer
 
     out = {}
     tables = {}
+    os.environ["RDT_ETL_AQE"] = "0"
     for mode, env in (("naive", "0"), ("opt", "1")):
         os.environ["RDT_ETL_OPTIMIZER"] = env
         assert optimizer.enabled() == (env == "1")
@@ -92,6 +108,7 @@ def run_config(session, action, sort_keys):
         out[f"rows_{mode}"] = sum(r["rows_shuffled"] for r in report)
         out[f"wall_{mode}_s"] = round(wall, 4)
         tables[mode] = table.sort_by([(k, "ascending") for k in sort_keys])
+    os.environ.pop("RDT_ETL_AQE", None)
     out["reduction_x"] = round(out["bytes_naive"] / max(out["bytes_opt"], 1), 2)
     out["identical"] = tables["naive"].equals(tables["opt"])
     out["stages_opt"] = [r["stage"] for r in
@@ -118,6 +135,9 @@ def run_consolidate_config(session, rows, maps, buckets):
     server = get_runtime().store_server
     out = {"maps": maps, "buckets": buckets, "rows": rows}
     tables = {}
+    # AQE off: the leg compares per-bucket vs consolidated CONTROL traffic
+    # at a fixed 64-reduce fan-in; coalescing would collapse the reduce side
+    os.environ["RDT_ETL_AQE"] = "0"
     for mode, env in (("naive", "0"), ("consolidated", "1")):
         os.environ["RDT_SHUFFLE_CONSOLIDATE"] = env
         session.engine.reset_shuffle_stage_report()
@@ -133,6 +153,7 @@ def run_consolidate_config(session, rows, maps, buckets):
         tables[mode] = table.sort_by([("k", "ascending"),
                                       ("v", "ascending")])
     os.environ.pop("RDT_SHUFFLE_CONSOLIDATE", None)
+    os.environ.pop("RDT_ETL_AQE", None)
     out["rpc_reduction_x"] = round(
         out["store_rpcs_naive"] / max(out["store_rpcs_consolidated"], 1), 2)
     out["identical"] = tables["naive"].equals(tables["consolidated"])
@@ -164,6 +185,8 @@ def run_straggler_config(smoke):
         os.environ["RDT_FAULTS"] = (
             f"executor.run_task:delay:ms={delay_ms}:match={victim}|")
         os.environ["RDT_SPECULATION"] = env
+        # fixed reduce fan-in: isolate speculation from AQE coalescing
+        os.environ["RDT_ETL_AQE"] = "0"
         # half the stage rides the straggler, so the default 0.75 completion
         # gate could never open; the min floor keeps smoke thresholds honest
         os.environ["RDT_SPECULATION_QUANTILE"] = "0.5"
@@ -195,12 +218,195 @@ def run_straggler_config(smoke):
         finally:
             raydp_tpu.stop()
             for k in ("RDT_FAULTS", "RDT_SPECULATION",
-                      "RDT_SPECULATION_QUANTILE", "RDT_SPECULATION_MIN_S"):
+                      "RDT_SPECULATION_QUANTILE", "RDT_SPECULATION_MIN_S",
+                      "RDT_ETL_AQE"):
                 os.environ.pop(k, None)
     out["speedup_x"] = round(out["wall_off_s"] / max(out["wall_on_s"], 1e-9),
                              2)
     out["identical"] = tables["off"].equals(tables["on"])
     return out
+
+
+def run_aqe_broadcast_config(session, rows, parts):
+    """Rule (a): the SHUFFLE_BYTES join config (wide frame ⋈ small dim)
+    with AQE off vs on. On: the dim side replicates (one ranged fetch per
+    executor) and NEITHER side hash-shuffles — the recorded number is how
+    many fewer bytes cross the store as shuffle/broadcast payload."""
+    from raydp_tpu.etl import functions as F
+
+    df = make_frame(session, rows, 16, parts)
+    dim = session.createDataFrame(
+        pd.DataFrame({"k": np.arange(16), "label": np.arange(16) * 3}),
+        num_partitions=2)
+    out = {"rows": rows}
+    tables = {}
+    for mode, env in (("off", "0"), ("on", "1")):
+        os.environ["RDT_ETL_AQE"] = env
+        session.engine.reset_shuffle_stage_report()
+        t0 = time.perf_counter()
+        table = (df.join(dim, on="k").select("k", "c0", "label").to_arrow())
+        out[f"wall_{mode}_s"] = round(time.perf_counter() - t0, 4)
+        report = session.engine.shuffle_stage_report()
+        out[f"bytes_{mode}"] = sum(r["bytes_shuffled"] for r in report)
+        out[f"stages_{mode}"] = [r["stage"] for r in report]
+        out[f"aqe_broadcast_{mode}"] = sum(r.get("aqe_broadcast", 0)
+                                           for r in report)
+        tables[mode] = table.sort_by([("k", "ascending"),
+                                      ("c0", "ascending")])
+    os.environ.pop("RDT_ETL_AQE", None)
+    out["reduction_x"] = round(out["bytes_off"] / max(out["bytes_on"], 1), 2)
+    out["identical"] = tables["off"].equals(tables["on"])
+    return out
+
+
+def run_aqe_skew_config(smoke):
+    """Rule (b): a seeded skewed-key groupby — ONE hot key holds ~50% of the
+    rows (the rest are unique, arranged unique-first per partition so the
+    cardinality guard emits row-wise partials and the skew SURVIVES to the
+    reduce side). The data plane is made honest about byte cost with a
+    seeded ``shuffle.fetch`` delay (``ms_per_mb=`` — the skew-mitigation
+    analogue of STRAGGLER.json's seeded one-executor delay): on this
+    single-core host the win is overlap, exactly what splitting the hot
+    bucket's byte-ranges across k reduce tasks buys. Speculation is pinned
+    off in BOTH modes (orthogonal; chaos tests cover the composition)."""
+    import raydp_tpu
+    from raydp_tpu.etl import functions as F
+
+    rows = 40_000 if smoke else 400_000
+    parts = 8
+    ms_per_mb = 2000 if smoke else 600
+    out = {"rows": rows, "maps": parts, "ms_per_mb": ms_per_mb,
+           "hot_fraction": 0.5}
+    rng = np.random.RandomState(13)
+    nuniq = rows // 2
+    # hot key 0 (~50% of rows); unique keys elsewhere; per-chunk layout =
+    # [unique..., hot...] so each map task's sampled prefix looks distinct
+    per = rows // parts
+    chunks = []
+    next_uniq = 1
+    for _ in range(parts):
+        nu = per // 2
+        ks = np.concatenate([np.arange(next_uniq, next_uniq + nu) * 7 + 3,
+                             np.zeros(per - nu, dtype=np.int64)])
+        next_uniq += nu
+        chunks.append(pd.DataFrame(
+            {"k": ks, "v": rng.randint(0, 1000, per).astype(np.int64)}))
+    pdf = pd.concat(chunks).reset_index(drop=True)
+    tables = {}
+    for mode, env in (("off", "0"), ("on", "1")):
+        os.environ["RDT_FAULTS"] = (
+            f"shuffle.fetch:delay:ms=0:ms_per_mb={ms_per_mb}")
+        os.environ["RDT_SPECULATION"] = "0"
+        os.environ["RDT_ETL_AQE"] = env
+        os.environ["RDT_AQE_COALESCE_MIN"] = "65536"
+        # 4 executors × (max_concurrency 2) = 8 overlappable fetch slots:
+        # the split portions' delays must be able to overlap (they are
+        # waits, not CPU — same reason the straggler leg's sleeps overlap)
+        session = raydp_tpu.init(f"aqe_skew_{mode}", num_executors=4,
+                                 executor_cores=1, executor_memory="512MB")
+        try:
+            df = session.createDataFrame(pdf, num_partitions=parts)
+            session.engine.reset_shuffle_stage_report()
+            t0 = time.perf_counter()
+            table = (df.groupBy("k")
+                     .agg(F.sum("v").alias("sv"), F.count("v").alias("n"))
+                     .to_arrow())
+            out[f"wall_{mode}_s"] = round(time.perf_counter() - t0, 4)
+            report = session.engine.shuffle_stage_report()
+            out[f"aqe_split_{mode}"] = sum(r.get("aqe_split", 0)
+                                           for r in report)
+            tables[mode] = table.sort_by([("k", "ascending")])
+        finally:
+            raydp_tpu.stop()
+            for k in ("RDT_FAULTS", "RDT_SPECULATION", "RDT_ETL_AQE",
+                      "RDT_AQE_COALESCE_MIN"):
+                os.environ.pop(k, None)
+    out["speedup_x"] = round(out["wall_off_s"] / max(out["wall_on_s"], 1e-9),
+                             2)
+    out["identical"] = tables["off"].equals(tables["on"])
+    return out
+
+
+def run_aqe_coalesce_config(session, rows, maps, buckets):
+    """Rule (c): the 64×64 many-partition repartition — with AQE on,
+    adjacent kilobyte-sized reduce buckets fuse into multi-range reads, so
+    the reduce side stops paying a dispatch per tiny bucket. The recorded
+    number is the reduce-task (dispatch) reduction."""
+    rng = np.random.RandomState(17)
+    pdf = pd.DataFrame({"k": rng.randint(0, 1_000_000, rows),
+                        "v": rng.randint(0, 1_000_000, rows)})
+    df = session.createDataFrame(pdf, num_partitions=maps)
+    out = {"maps": maps, "buckets": buckets, "rows": rows}
+    tables = {}
+    for mode, env in (("off", "0"), ("on", "1")):
+        os.environ["RDT_ETL_AQE"] = env
+        session.engine.reset_shuffle_stage_report()
+        t0 = time.perf_counter()
+        table = df.repartition(buckets).to_arrow()
+        out[f"wall_{mode}_s"] = round(time.perf_counter() - t0, 4)
+        report = session.engine.shuffle_stage_report()
+        fused = sum(r.get("aqe_coalesced", 0) for r in report)
+        out[f"reduce_tasks_{mode}"] = buckets - fused
+        tables[mode] = table.sort_by([("k", "ascending"),
+                                      ("v", "ascending")])
+    os.environ.pop("RDT_ETL_AQE", None)
+    out["dispatch_reduction_x"] = round(
+        out["reduce_tasks_off"] / max(out["reduce_tasks_on"], 1), 2)
+    out["identical"] = tables["off"].equals(tables["on"])
+    return out
+
+
+def main_aqe(smoke):
+    """The ``--aqe`` leg: all three adaptive rules measured off vs on, one
+    record per rule, written to benchmarks/AQE.json (``--smoke`` → /tmp)."""
+    import raydp_tpu
+
+    default_path = ("/tmp/AQE_SMOKE.json" if smoke else
+                    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "AQE.json"))
+    out_path = os.environ.get("RDT_AQE_PATH", default_path)
+    rows = 4_000 if smoke else 400_000
+    parts = 4 if smoke else 8
+    record = {
+        "metric": "etl_aqe",
+        "unit": "off/on per rule: shuffled bytes (broadcast), stage wall "
+                "(skew split), reduce dispatches (coalesce)",
+        "smoke": smoke,
+        "configs": {},
+    }
+    session = raydp_tpu.init("aqe_bench", num_executors=2, executor_cores=2,
+                             executor_memory="1GB")
+    try:
+        record["configs"]["broadcast_join"] = run_aqe_broadcast_config(
+            session, rows, parts)
+        mp, bk = (16, 16) if smoke else (64, 64)
+        record["configs"]["coalesce_many"] = run_aqe_coalesce_config(
+            session, rows=mp * (100 if smoke else 600), maps=mp, buckets=bk)
+    finally:
+        raydp_tpu.stop()
+    record["configs"]["skew_groupby"] = run_aqe_skew_config(smoke)
+
+    record["value"] = record["configs"]["broadcast_join"]["reduction_x"]
+    record["all_identical"] = all(c["identical"]
+                                  for c in record["configs"].values())
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, "w") as fh:
+        json.dump(record, fh, indent=2, sort_keys=True)
+    print(json.dumps({k: v for k, v in record.items() if k != "configs"}))
+    bc = record["configs"]["broadcast_join"]
+    print(f"broadcast_join: bytes {bc['bytes_off']} -> {bc['bytes_on']} "
+          f"({bc['reduction_x']}x), stages {bc['stages_on']}, "
+          f"identical={bc['identical']}")
+    sk = record["configs"]["skew_groupby"]
+    print(f"skew_groupby: wall {sk['wall_off_s']}s -> {sk['wall_on_s']}s "
+          f"({sk['speedup_x']}x), splits {sk['aqe_split_on']}, "
+          f"identical={sk['identical']}")
+    co = record["configs"]["coalesce_many"]
+    print(f"coalesce_many: reduce tasks {co['reduce_tasks_off']} -> "
+          f"{co['reduce_tasks_on']} ({co['dispatch_reduction_x']}x), wall "
+          f"{co['wall_off_s']}s -> {co['wall_on_s']}s, "
+          f"identical={co['identical']}")
+    return record
 
 
 def main_straggler(smoke):
@@ -232,6 +438,8 @@ def main():
     smoke = "--smoke" in sys.argv
     if "--straggler" in sys.argv:
         return main_straggler(smoke)
+    if "--aqe" in sys.argv:
+        return main_aqe(smoke)
     rows = 4_000 if smoke else 400_000
     parts = 4 if smoke else 8
     default_path = ("/tmp/SHUFFLE_BYTES_SMOKE.json" if smoke else

@@ -843,6 +843,10 @@ class Engine:
                                         for r in results),
                  "per_executor_busy": dict(
                      (sched_stats or {}).get("per_executor_busy") or {}),
+                 # adaptive-execution accounting: joins converted to
+                 # broadcast, skewed buckets split, and buckets fused away
+                 # by coalescing (all 0 when AQE is off or no rule fired)
+                 "aqe_broadcast": 0, "aqe_split": 0, "aqe_coalesced": 0,
                  # lineage-recovery accounting: blobs regenerated for this
                  # stage's intermediates, and how many recovery events ran
                  "regenerated": 0, "recovered": 0}
@@ -881,7 +885,13 @@ class Engine:
         finished first (map tasks plus the stage's reduce-side consumers;
         0/0 on a straggler-free run); ``per_executor_busy`` maps executor
         name → the peak in-flight task depth the least-loaded dispatcher
-        drove it to during the map stage. ``regenerated`` counts intermediate blobs rebuilt
+        drove it to during the map stage. ``aqe_broadcast``/``aqe_split``/
+        ``aqe_coalesced`` count adaptive re-planning events on the stage:
+        joins converted to broadcast-hash (the ``join-broadcast`` entry is
+        the pre-shuffle form; a post-map conversion marks the map stage it
+        measured), skewed buckets split across extra reduce tasks, and
+        reduce buckets fused away by tiny-partition coalescing (all 0 with
+        ``RDT_ETL_AQE=0`` or when no rule fired). ``regenerated`` counts intermediate blobs rebuilt
         through lineage recovery after a store loss, ``recovered`` the
         recovery events that rebuilt them (0/0 on a fault-free run)."""
         with self._report_lock:
@@ -908,6 +918,8 @@ class Engine:
                          "fetch_rpcs": 0, "consolidated": False,
                          "speculated": 0, "speculation_won": 0,
                          "per_executor_busy": {},
+                         "aqe_broadcast": 0, "aqe_split": 0,
+                         "aqe_coalesced": 0,
                          "regenerated": 0, "recovered": 0}
                 self._stage_reports.append(entry)
                 temps.stage_entries[prod.label] = entry
@@ -960,9 +972,7 @@ class Engine:
         consolidated format) through :class:`tasks.RangeRefSource` — with
         legacy refs normalized to full-blob ranges when a stage mixes both."""
         if any(isinstance(x, tuple) for x in bucket):
-            parts = [x if isinstance(x, tuple) else (x, 0, x.size)
-                     for x in bucket]
-            return T.RangeRefSource(parts, schema=schema)
+            return T.RangeRefSource(Engine._as_parts(bucket), schema=schema)
         return T.ArrowRefSource(list(bucket), schema=schema)
 
     def _bucket_task(self, bucket: Sequence[Any], schema: Optional[bytes],
@@ -972,6 +982,136 @@ class Engine:
         task = self._task(self._bucket_source(bucket, schema), steps)
         task.consumes_stage = label
         return task
+
+    # ---- adaptive query execution (AQE) -------------------------------------
+    # The three runtime re-planning rules (doc/etl.md "Adaptive execution"):
+    # (a) broadcast-hash join — a join side whose MEASURED bytes fit under
+    #     RDT_AQE_BROADCAST_MAX skips its shuffle and replicates instead
+    #     (pre-shuffle when a static estimate flags it, post-map when the
+    #     left map stage's byte counters reveal it);
+    # (b) skew splitting — a reduce bucket exceeding RDT_AQE_SKEW_FACTOR ×
+    #     the median bucket splits its byte-ranges across k reduce tasks
+    #     (free at range granularity with the consolidated per-bucket index);
+    # (c) tiny-partition coalescing — adjacent buckets fuse into one reduce
+    #     task until their combined bytes reach RDT_AQE_COALESCE_MIN.
+    # Rules (b)/(c) need the consolidated size index (RDT_SHUFFLE_CONSOLIDATE
+    # =0 simply never fires them); every re-planned task flows through
+    # _run_stage like any other, so lineage recovery, speculation, and the
+    # abort/no-orphan contract compose unchanged.
+
+    @staticmethod
+    def _as_parts(bucket: Sequence[Any]) -> List[Tuple[ObjectRef, int, int]]:
+        """Normalize a bucket's items to (ref, offset, size) byte-range
+        triples (legacy whole-blob refs become full-blob ranges)."""
+        return [x if isinstance(x, tuple) else (x, 0, int(x.size or 0))
+                for x in bucket]
+
+    @staticmethod
+    def _bucket_bytes(buckets: Sequence[Sequence[Any]]) -> Optional[List[int]]:
+        """Measured per-bucket byte totals from the consolidated index, or
+        None when any bucket lacks it (legacy blobs — rules (b)/(c) then
+        don't fire; a whole-blob ref's .size IS its bucket's bytes only on
+        the consolidated-off path where the index is absent anyway)."""
+        if not all(isinstance(x, tuple) for b in buckets for x in b):
+            return None
+        return [sum(int(size) for _, _, size in b) for b in buckets]
+
+    def _note_aqe(self, temps, label: str, rule: str, n: int,
+                  **trace_args) -> None:
+        """Credit a fired AQE rule to the action's stage entry and emit the
+        ``aqe:replan`` trace span."""
+        if isinstance(temps, _ActionTemps):
+            with self._report_lock:
+                entry = temps.stage_entries.get(label)
+                if entry is not None:
+                    entry[rule] = entry.get(rule, 0) + n
+        with profiler.trace("aqe:replan", "etl", stage=label, rule=rule,
+                            n=n, **trace_args):
+            pass
+
+    def _aqe_coalesce(self, buckets: List[List[Any]], label: str, temps,
+                      paired: Optional[List[List[Any]]] = None):
+        """Rule (c): fuse runs of adjacent buckets until each fused group's
+        measured bytes reach RDT_AQE_COALESCE_MIN — one multi-range read per
+        group instead of one dispatch per kilobyte-sized bucket. Safe for
+        every hash-bucketed op (a key's rows stay together under bucket
+        union); ``paired`` fuses a join's right buckets in lockstep with the
+        left so each reduce task still sees matching key ranges. Returns
+        (buckets, paired)."""
+        cmin = O.aqe_coalesce_min()
+        if not O.aqe_enabled() or cmin <= 0 or len(buckets) < 2:
+            return buckets, paired
+        sizes = self._bucket_bytes(buckets)
+        psizes = self._bucket_bytes(paired) if paired is not None else \
+            [0] * len(buckets)
+        if sizes is None or psizes is None:
+            return buckets, paired  # no size index (legacy blobs)
+        fused: List[List[Any]] = []
+        pfused: List[List[Any]] = []
+        cur_bytes = 0
+        for b, bucket in enumerate(buckets):
+            size = sizes[b] + psizes[b]
+            if fused and cur_bytes + size <= cmin:
+                fused[-1] = list(fused[-1]) + list(bucket)
+                if paired is not None:
+                    pfused[-1] = list(pfused[-1]) + list(paired[b])
+                cur_bytes += size
+            else:
+                fused.append(list(bucket))
+                if paired is not None:
+                    pfused.append(list(paired[b]))
+                cur_bytes = size
+        away = len(buckets) - len(fused)
+        if away > 0:
+            self._note_aqe(temps, label, "aqe_coalesced", away,
+                           buckets=len(buckets), fused=len(fused))
+        return fused, (pfused if paired is not None else None)
+
+    def _aqe_split_groups(self, buckets: List[List[Any]]
+                          ) -> Optional[List[List[List[Any]]]]:
+        """Rule (b) detector: per bucket, either ``[bucket]`` (no skew) or k
+        byte-balanced contiguous range groups when the bucket's measured
+        bytes exceed RDT_AQE_SKEW_FACTOR × the median bucket (and the
+        2×RDT_AQE_COALESCE_MIN floor — a bucket below the coalesce target
+        is never worth an extra stage). None when nothing splits."""
+        factor = O.aqe_skew_factor()
+        if not O.aqe_enabled() or factor <= 0 or len(buckets) < 2:
+            return None
+        sizes = self._bucket_bytes(buckets)
+        if sizes is None:
+            return None
+        # LOWER median: with an even count (notably 2 buckets after heavy
+        # coalescing), the upper median IS the hot bucket and skew could
+        # never exceed factor × itself
+        med = max(1, sorted(sizes)[(len(sizes) - 1) // 2])
+        floor = 2 * O.aqe_coalesce_min()
+        # split portions aim at median-bucket size (floored by the coalesce
+        # target — splitting below what coalescing would fuse is pure churn)
+        split_target = max(med, O.aqe_coalesce_min(), 1)
+        out: List[List[List[Any]]] = []
+        fired = False
+        for bucket, size in zip(buckets, sizes):
+            if size <= factor * med or size < floor or len(bucket) < 2:
+                out.append([list(bucket)])
+                continue
+            k = min(len(bucket), max(2, math.ceil(size / split_target)))
+            target = size / k
+            groups: List[List[Any]] = [[]]
+            acc = 0
+            for part in bucket:
+                psz = int(part[2]) if isinstance(part, tuple) else 0
+                if groups[-1] and acc + psz > target \
+                        and len(groups) < k:
+                    groups.append([])
+                    acc = 0
+                groups[-1].append(part)
+                acc += psz
+            if len(groups) < 2:
+                out.append([list(bucket)])
+                continue
+            fired = True
+            out.append(groups)
+        return out if fired else None
 
     @staticmethod
     def _free(temps: List[ObjectRef]) -> None:
@@ -1481,9 +1621,23 @@ class Engine:
         the most input bytes. One bulk ``locations`` RPC; a no-op on
         single-machine pools so round-robin balance is untouched. Parity:
         preferred locations from block owner addresses
-        (RayDatasetRDD.scala:48-56, RayDPExecutor.scala:271-287)."""
+        (RayDatasetRDD.scala:48-56, RayDPExecutor.scala:271-287).
+
+        A task's entry may hold plain refs, ``(ref, offset, size)`` range
+        triples, or nested lists of either (a coalesced multi-range read
+        fusing several buckets): EVERY range contributes its own byte
+        weight, so a multi-range source is routed by the total bytes it
+        reads across all its (ref, off, size) triples — not just wherever
+        its first ref happens to live."""
         if not self.pool.multi_host():
             return [None] * len(ref_lists)
+
+        def _flat(items):
+            for item in items:
+                if isinstance(item, list):
+                    yield from _flat(item)
+                else:
+                    yield item
 
         def _norm(item) -> Tuple[Optional[ObjectRef], int]:
             # items are refs OR (ref, offset, size) range triples — weight a
@@ -1491,13 +1645,13 @@ class Engine:
             if isinstance(item, tuple):
                 return item[0], max(int(item[2]), 1)
             if item is not None:
-                return item, max(item.size, 1)
+                return item, max(int(item.size or 0), 1)
             return None, 0
 
         try:
             seen: Dict[str, ObjectRef] = {}
             for refs in ref_lists:
-                for item in refs:
+                for item in _flat(refs):
                     r, _ = _norm(item)
                     if r is not None:
                         seen[r.id] = r
@@ -1507,7 +1661,7 @@ class Engine:
         preferred: List[Optional[str]] = []
         for refs in ref_lists:
             weight: Dict[str, int] = {}
-            for item in refs:
+            for item in _flat(refs):
                 r, w = _norm(item)
                 host = locs.get(r.id) if r is not None else None
                 if host is not None:
@@ -1558,12 +1712,15 @@ class Engine:
                           keys: Optional[List[str]], temps: List[ObjectRef],
                           range_key=None, pre_steps: Optional[List[T.Step]] = None,
                           label: str = "shuffle",
+                          stats: Optional[Dict[str, Any]] = None,
                           ) -> Tuple[List[List[ObjectRef]], Optional[bytes]]:
         """Execute ``node`` with SHUFFLE output; transpose map×bucket → bucket×map.
 
         ``pre_steps`` run on each map task AFTER the narrow chain and BEFORE
         bucketing (the hook map-side partial aggregation uses); ``label`` names
-        the stage in the engine's shuffle ledger."""
+        the stage in the engine's shuffle ledger. ``stats``, when given, is
+        filled with the stage's measured ``bytes_shuffled`` — the number the
+        AQE post-map broadcast rule re-plans on."""
         tasks, preferred = self._compile(node, temps)
         extra = list(pre_steps or [])
         tasks = [t.with_output(steps=t.steps + extra,
@@ -1579,7 +1736,53 @@ class Engine:
         self._record_stage(label, results, num_buckets, temps,
                            sched_stats=sstats)
         schema = results[0]["schema"] if results else None
+        if stats is not None:
+            stats["bytes_shuffled"] = sum(int(r.get("shuffle_bytes", 0))
+                                          for r in results)
         return self._gather_buckets(results, num_buckets, temps), schema
+
+    def _aqe_split_partial_agg(self, buckets: List[List[Any]],
+                               schema: Optional[bytes], keys: List[str],
+                               partials, label: str,
+                               temps: List[ObjectRef]) -> List[List[Any]]:
+        """Rule (b) for a decomposable aggregation: run an INLINE stage of
+        split tasks over each skewed bucket's range groups — each merges its
+        portion's partials into partials (:class:`tasks.
+        GroupAggPartialMergeStep`) — then hand the final reduce task the
+        split outputs instead of the raw ranges, so the ordinary
+        ``GroupAggMergeStep`` finishes the bucket unchanged. The split
+        outputs are ledgered under the map stage's label: a lost split blob
+        regenerates through the same recovery path as any intermediate (its
+        producer itself reads ledgered map blobs, so nested losses recover
+        transitively)."""
+        groups = self._aqe_split_groups(buckets)
+        if groups is None:
+            return buckets
+        split_tasks, split_pref_parts, placed = [], [], []
+        for b, portions in enumerate(groups):
+            if len(portions) < 2:
+                continue
+            for portion in portions:
+                split_tasks.append(
+                    self._bucket_task(portion, schema,
+                                      [T.GroupAggPartialMergeStep(
+                                          list(keys), list(partials))],
+                                      label)
+                    .with_output(owner=self.owner))
+                split_pref_parts.append(list(portion))
+            placed.append((b, len(portions)))
+        results = self._run_stage(split_tasks,
+                                  self._locality(split_pref_parts), temps,
+                                  lineage_label=label)
+        out = [list(b) for b in buckets]
+        it = iter(results)
+        for b, n in placed:
+            refs = [next(it)["ref"] for _ in range(n)]
+            temps.extend(refs)
+            out[b] = [(r, 0, int(r.size or 0)) for r in refs]
+        self._note_aqe(temps, label, "aqe_split", len(placed),
+                       tasks=len(split_tasks))
+        return out
 
     def _compile_repartition(self, node: P.Repartition, temps: List[ObjectRef]):
         n = node.num_partitions
@@ -1595,6 +1798,15 @@ class Engine:
             return tasks, self._locality(groups)
         buckets, schema = self._shuffle_children(node.child, n, keys=None,
                                                  temps=temps, label="repartition")
+        buckets, _ = self._aqe_coalesce(buckets, "repartition", temps)
+        # skewed buckets split into SEPARATE output partitions (repartition
+        # makes no key promise, so the "merge" of split outputs is just the
+        # action-level concat — no combiner stage, no extra data movement)
+        groups = self._aqe_split_groups(buckets)
+        if groups is not None:
+            self._note_aqe(temps, "repartition", "aqe_split",
+                           sum(1 for g in groups if len(g) > 1))
+            buckets = [portion for g in groups for portion in g]
         tasks = [self._bucket_task(bucket, schema, None, "repartition")
                  for bucket in buckets]
         return tasks, self._locality(buckets)
@@ -1611,43 +1823,168 @@ class Engine:
                 node.child, nb, keys=node.keys, temps=temps,
                 pre_steps=[T.GroupAggPartialStep(node.keys, partials)],
                 label="groupagg-partial")
+            buckets, _ = self._aqe_coalesce(buckets, "groupagg-partial",
+                                            temps)
+            buckets = self._aqe_split_partial_agg(buckets, schema, node.keys,
+                                                  partials,
+                                                  "groupagg-partial", temps)
             tasks = [self._bucket_task(bucket, schema,
                                        [T.GroupAggMergeStep(node.keys, merges)],
                                        "groupagg-partial")
                      for bucket in buckets]
             return tasks, self._locality(buckets)
+        # single-phase fallback (non-decomposable aggs / optimizer off): a
+        # key's rows must all reach ONE task, so skew splitting cannot apply
+        # — only coalescing does
         buckets, schema = self._shuffle_children(node.child, nb, keys=node.keys,
                                                  temps=temps, label="groupagg")
+        buckets, _ = self._aqe_coalesce(buckets, "groupagg", temps)
         tasks = [self._bucket_task(bucket, schema,
                                    [T.GroupAggStep(node.keys, node.aggs)],
                                    "groupagg")
                  for bucket in buckets]
         return tasks, self._locality(buckets)
 
-    def _compile_join(self, node: P.Join, temps: List[ObjectRef]):
+    def _aqe_broadcast_pre(self, node: P.Join, temps, bmax: int):
+        """Rule (a), pre-shuffle form: when a static estimate says one
+        (semantically broadcastable) side fits under ``bmax``, materialize it
+        and CONFIRM with measured bytes — if confirmed, neither side buckets:
+        the big side's partitions stream against executor-local replicas of
+        the small side (one ranged fetch per executor). A lying estimate
+        degrades gracefully: the materialized refs shuffle as an in-memory
+        side through the ordinary bucketed join. Returns compiled (tasks,
+        preferred) or None when the rule doesn't apply."""
+        cands = []
+        rest = O.estimate_plan_bytes(node.right)
+        if rest is not None and rest <= bmax \
+                and node.how in T.BROADCAST_RIGHT_JOIN_TYPES:
+            cands.append(("right", rest))
+        lest = O.estimate_plan_bytes(node.left)
+        if lest is not None and lest <= bmax \
+                and node.how in T.BROADCAST_LEFT_JOIN_TYPES:
+            cands.append(("left", lest))
+        if not cands:
+            return None
+        side = min(cands, key=lambda c: c[1])[0]
+        small = node.right if side == "right" else node.left
+        big = node.left if side == "right" else node.right
+        stasks, spref = self._compile(small, temps)
+        if not stasks:
+            return None  # degenerate 0-task side: keep the bucketed path
+        stasks = [t.with_output(output=T.RETURN_REF, owner=self.owner)
+                  for t in stasks]
+        sstats: Dict[str, Any] = {}
+        results = self._run_stage(stasks, spref, temps,
+                                  lineage_label="join-broadcast",
+                                  sched_stats=sstats)
+        refs = [r["ref"] for r in results]
+        temps.extend(refs)
+        schema = results[0]["schema"] if results else None
+        size = sum(int(getattr(r, "size", 0) or 0) for r in refs)
+
+        def _fallback():
+            # bucketed join reusing the materialization as an in-memory
+            # side (its blobs are ledgered, so nothing is wasted or lost)
+            mem = P.InMemory(refs, schema=schema)
+            fb = P.Join(mem, node.right, node.keys, node.right_keys,
+                        node.how) if side == "left" else \
+                P.Join(node.left, mem, node.keys, node.right_keys, node.how)
+            return self._compile_join(fb, temps, allow_broadcast=False)
+
+        if size > bmax or schema is None:
+            return _fallback()  # measured bytes overrule the estimate
+        # the big side compiles only now that the broadcast is confirmed —
+        # its own wide subtrees execute exactly once either way
+        big_tasks, big_pref = self._compile(big, temps)
+        if not big_tasks:
+            return _fallback()
+        # the broadcast side's movement, in the ledger: what crossed the
+        # store once (ref.size = serialized payload), under its own label
+        for r in results:
+            r["shuffle_bytes"] = int(r["ref"].size or 0)
+            r.setdefault("shuffle_bytes_in", int(r.get("nbytes", 0)))
+        self._record_stage("join-broadcast", results, 0, temps,
+                           sched_stats=sstats)
+        self._note_aqe(temps, "join-broadcast", "aqe_broadcast", 1,
+                       side=side, bytes=size)
+        step = T.BroadcastJoinStep([(r, 0, int(r.size or 0)) for r in refs],
+                                   list(node.keys), list(node.right_keys),
+                                   node.how, broadcast_side=side,
+                                   schema=schema)
+        tasks = [t.with_output(steps=t.steps + [step],
+                               consumes_stage="join-broadcast")
+                 for t in big_tasks]
+        return tasks, big_pref
+
+    def _compile_join(self, node: P.Join, temps: List[ObjectRef],
+                      allow_broadcast: bool = True):
         nb = self._num_buckets()
+        bmax = O.aqe_broadcast_max() if O.aqe_enabled() else 0
+        if bmax > 0 and allow_broadcast:
+            out = self._aqe_broadcast_pre(node, temps, bmax)
+            if out is not None:
+                return out
+        lstats: Dict[str, Any] = {}
         left_buckets, lschema = self._shuffle_children(node.left, nb, node.keys,
-                                                       temps, label="join-left")
+                                                       temps, label="join-left",
+                                                       stats=lstats)
+        # rule (a), post-map form: the left map stage's measured bytes reveal
+        # a small side no estimate could see (aggregated/joined subtrees).
+        # Converting HERE — before the right side buckets — is what saves the
+        # big side's shuffle: right partitions stream against replicas built
+        # from the left's already-written map blobs (every bucket's range).
+        if allow_broadcast and bmax > 0 and lschema is not None \
+                and lstats.get("bytes_shuffled", 0) <= bmax \
+                and node.how in T.BROADCAST_LEFT_JOIN_TYPES:
+            right_tasks, right_pref = self._compile(node.right, temps)
+            if right_tasks:
+                parts = [p for lb in left_buckets
+                         for p in self._as_parts(lb)]
+                self._note_aqe(temps, "join-left", "aqe_broadcast", 1,
+                               side="left",
+                               bytes=lstats.get("bytes_shuffled", 0))
+                step = T.BroadcastJoinStep(
+                    parts, list(node.keys), list(node.right_keys), node.how,
+                    broadcast_side="left", schema=lschema)
+                tasks = [t.with_output(steps=t.steps + [step],
+                                       consumes_stage="join-left")
+                         for t in right_tasks]
+                return tasks, right_pref
         right_buckets, rschema = self._shuffle_children(node.right, nb,
                                                         node.right_keys, temps,
                                                         label="join-right")
-        tasks = []
-        for lb, rb in zip(left_buckets, right_buckets):
+        left_buckets, right_buckets = self._aqe_coalesce(
+            left_buckets, "join-left", temps, paired=right_buckets)
+        # rule (b) on the probe side: a skewed left bucket's ranges split
+        # across k join tasks, each probing the SAME right bucket — an inner/
+        # semi/outer-left row lands in exactly one split, so the concat of
+        # split outputs (the action-level gather) is the bucket's join. The
+        # gate is the same partition-safety condition as broadcasting the
+        # right side: any join type that emits RIGHT-side rows on their own
+        # (right/full outer, right semi/anti) would emit them once per
+        # split, because every split probes the whole right bucket
+        split_groups = self._aqe_split_groups(left_buckets) \
+            if node.how in T.BROADCAST_RIGHT_JOIN_TYPES else None
+        tasks, pref_parts = [], []
+        for b, (lb, rb) in enumerate(zip(left_buckets, right_buckets)):
             if any(isinstance(x, tuple) for x in rb):
-                right_parts = [x if isinstance(x, tuple) else (x, 0, x.size)
-                               for x in rb]
                 join_step = T.HashJoinStep([], node.keys, node.right_keys,
                                            node.how, right_schema=rschema,
-                                           right_parts=right_parts)
+                                           right_parts=self._as_parts(rb))
             else:
                 join_step = T.HashJoinStep(list(rb), node.keys,
                                            node.right_keys, node.how,
                                            right_schema=rschema)
-            tasks.append(self._bucket_task(lb, lschema, [join_step],
-                                           "join-left"))
-        # a join task reads BOTH sides' buckets: weight locality over them
-        return tasks, self._locality([list(lb) + list(rb) for lb, rb
-                                      in zip(left_buckets, right_buckets)])
+            portions = split_groups[b] if split_groups is not None else [lb]
+            for portion in portions:
+                tasks.append(self._bucket_task(portion, lschema, [join_step],
+                                               "join-left"))
+                # a join task reads BOTH sides: weight locality over them
+                pref_parts.append(list(portion) + list(rb))
+        if split_groups is not None:
+            self._note_aqe(temps, "join-left", "aqe_split",
+                           sum(1 for g in split_groups if len(g) > 1))
+        return tasks, self._locality(pref_parts)
 
     def _compile_sort(self, node: P.Sort, temps: List[ObjectRef]):
         """Range-partitioned sort on the COMPOSITE key: materialize the child
@@ -1735,6 +2072,9 @@ class Engine:
         keys = list(node.subset) if node.subset else ["*"]
         buckets, schema = self._shuffle_children(node.child, nb, keys=keys,
                                                  temps=temps, label="distinct")
+        # equal keys share a bucket, and that stays true under bucket UNION:
+        # tiny-partition coalescing keeps local dedupe globally exact
+        buckets, _ = self._aqe_coalesce(buckets, "distinct", temps)
         tasks = [self._bucket_task(bucket, schema,
                                    [T.DistinctStep(node.subset)], "distinct")
                  for bucket in buckets]

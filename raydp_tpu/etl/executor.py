@@ -106,6 +106,53 @@ def current_block_cache() -> BlockCache:
     return _block_cache
 
 
+class BroadcastCache:
+    """Bounded process-local cache of broadcast-join build tables.
+
+    The AQE broadcast rule replicates a small join side to every executor;
+    this cache is the executor half of that replication — the FIRST
+    ``BroadcastJoinStep`` on an executor pays the batched ranged fetch, and
+    every sibling partition probes the already-built table. Keys embed the
+    exact (blob id, offset, size) ranges, so a lineage-regenerated broadcast
+    side (fresh blob ids) misses and refetches instead of probing stale
+    bytes. LRU-bounded: a long session running many different joins holds at
+    most ``max_entries`` small-side tables in executor RAM."""
+
+    def __init__(self, max_entries: int = 4):
+        self._lock = threading.Lock()
+        self._max = max_entries
+        self._tables: "dict" = {}  # insertion-ordered (LRU via re-insert)
+
+    def get_or_load(self, key, loader):
+        with self._lock:
+            hit = self._tables.pop(key, None)
+            if hit is not None:
+                self._tables[key] = hit  # re-insert: most recently used
+                return hit
+        # load OUTSIDE the lock: a slow fetch must not serialize sibling
+        # tasks probing other (cached) broadcasts; a duplicate concurrent
+        # load of the same key is benign (deterministic bytes, last wins)
+        table = loader()
+        with self._lock:
+            self._tables[key] = table
+            while len(self._tables) > self._max:
+                self._tables.pop(next(iter(self._tables)))
+        return table
+
+    def clear(self) -> None:
+        with self._lock:
+            self._tables.clear()
+
+
+_broadcast_cache = BroadcastCache()
+
+
+def broadcast_cache() -> BroadcastCache:
+    """The process-local broadcast-side table cache (executors; also used
+    in-process by unit tests running steps directly)."""
+    return _broadcast_cache
+
+
 class EtlExecutor:
     """Actor class. One instance per executor process."""
 

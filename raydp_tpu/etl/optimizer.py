@@ -47,6 +47,83 @@ def enabled() -> bool:
         "0", "false", "off", "no")
 
 
+# ==== adaptive query execution (AQE) knobs =========================================
+# The static rules above plan blind; the engine's AQE layer re-plans at stage
+# boundaries from MEASURED statistics (materialized bytes, the consolidated
+# shuffle's per-bucket size index). The knobs live here beside the optimizer
+# opt-out because they follow the same contract: read per action, so a test
+# or bench can flip them at runtime. A threshold of 0 disables its rule.
+
+def aqe_enabled() -> bool:
+    """Adaptive-execution master switch (default ON, ``RDT_ETL_AQE=0`` off).
+    Read per action like ``RDT_ETL_OPTIMIZER``."""
+    return os.environ.get("RDT_ETL_AQE", "1").lower() not in (
+        "0", "false", "off", "no")
+
+
+def aqe_broadcast_max() -> int:
+    """Broadcast-hash-join threshold: a join side whose MEASURED materialized
+    bytes fit under this skips its shuffle entirely and replicates to every
+    executor instead (default ~8MB, Spark's autoBroadcastJoinThreshold
+    ballpark). 0 disables rule (a)."""
+    return int(float(os.environ.get("RDT_AQE_BROADCAST_MAX",
+                                    str(8 << 20)) or 0))
+
+
+def aqe_skew_factor() -> float:
+    """Skew-mitigation trigger: a reduce bucket whose measured bytes exceed
+    this multiple of the median bucket splits its byte-ranges across several
+    reduce tasks. 0 disables rule (b)."""
+    return float(os.environ.get("RDT_AQE_SKEW_FACTOR", "4") or 0)
+
+
+def aqe_coalesce_min() -> int:
+    """Tiny-partition coalescing target: adjacent reduce buckets fuse into
+    one reduce task until their combined measured bytes reach this (default
+    1MB), so many-bucket configs stop paying a dispatch per kilobyte-sized
+    bucket. Doubles as the floor under which a bucket is never worth skew-
+    splitting. 0 disables rule (c) (and the split floor)."""
+    return int(float(os.environ.get("RDT_AQE_COALESCE_MIN",
+                                    str(1 << 20)) or 0))
+
+
+def estimate_plan_bytes(node: P.PlanNode) -> Optional[int]:
+    """Static upper-bound estimate of a plan's materialized bytes, or None
+    when nothing cheap is known. Used by the AQE pre-shuffle broadcast rule
+    to decide whether materializing a join side is worth trying at all — the
+    MEASURED size after materialization is what actually gates the
+    broadcast, so an over-estimate only costs a missed opportunity and an
+    under-estimate is corrected (the materialized refs shuffle as an
+    in-memory side instead)."""
+    if isinstance(node, P.InMemory):
+        return sum(int(getattr(r, "size", 0) or 0) for r in node.refs)
+    if isinstance(node, P.RangeScan):
+        n = max(0, node.stop - node.start)
+        return (n // max(node.step, 1) + 1) * 8
+    if isinstance(node, (P.CsvScan, P.ParquetScan)):
+        try:
+            return sum(os.path.getsize(p) for p in node.paths)
+        except OSError:
+            return None
+    if isinstance(node, P.Union):
+        total = 0
+        for child in node.inputs:
+            est = estimate_plan_bytes(child)
+            if est is None:
+                return None
+            total += est
+        return total
+    # row-preserving / row-shrinking unary ops: the child's bytes bound the
+    # output (WindowOp adds one column — close enough for an upper bound)
+    if isinstance(node, (P.Project, P.Rename, P.DropNa, P.Filter, P.Limit,
+                         P.Sample, P.SplitSelect, P.Repartition, P.Sort,
+                         P.Distinct, P.WindowOp)):
+        return estimate_plan_bytes(node.child)
+    # GroupAgg / Join / CachedScan outputs are not statically bounded; the
+    # post-map fallback (measured map bytes) covers those sides instead
+    return None
+
+
 def optimize(node: P.PlanNode) -> P.PlanNode:
     """Apply all plan rewrites (no-op when the knob disables the optimizer)."""
     if not enabled():

@@ -23,8 +23,10 @@ import pyarrow.compute as pc
 import pyarrow.csv as pacsv
 import pyarrow.parquet as pq
 
+from raydp_tpu import faults
 from raydp_tpu.etl.expressions import Expr, evaluate_to_array
-from raydp_tpu.runtime.object_store import ObjectRef, get_client
+from raydp_tpu.runtime.object_store import ObjectLostError, ObjectRef, \
+    get_client
 
 # -- output modes -------------------------------------------------------------------
 RETURN_REF = "return_ref"
@@ -159,6 +161,24 @@ class RangeRefSource(Step):
 
         client = get_client()
         total = sum(size for _, _, size in self.parts)
+        # the ranged-read fault site: ``drop`` removes one part's backing
+        # blob and surfaces the typed loss (the store-host-died model for
+        # consolidated reduce reads, skew-split portions, and broadcast
+        # replicas — all of which must route into lineage recovery); the
+        # generic ``delay`` additionally honors ``ms_per_mb=`` so a chaos
+        # schedule can model a slow data plane whose cost scales with the
+        # bytes a task actually fetches
+        rule = faults.check("shuffle.fetch",
+                            key=self.parts[0][0].id if self.parts else "")
+        if rule is not None:
+            if rule.action == "drop" and self.parts:
+                victim = self.parts[rule.bucket % len(self.parts)][0]
+                try:
+                    client.free([victim])
+                except Exception:
+                    pass
+                raise ObjectLostError(victim.id, "fault-injected fetch drop")
+            faults.apply(rule, "shuffle.fetch", nbytes=total)
         with profiler.trace("shuffle:fetch", "etl", parts=len(self.parts),
                             bytes=total):
             bufs = client.get_range_buffers(self.parts)
@@ -663,6 +683,29 @@ class GroupAggMergeStep(Step):
 
 
 @dataclass
+class GroupAggPartialMergeStep(Step):
+    """Merge map-side partials INTO partials (same schema in, same schema
+    out): the intermediate level of a skew-split aggregation. A hot bucket's
+    byte-ranges split across k reduce tasks, each running this step over its
+    portion; the outputs stay in partial form (count partials re-sum, sums
+    sum, min/min max/max) so the combining task's ordinary
+    :class:`GroupAggMergeStep` finishes them exactly as if the bucket had
+    never been split — mean still divides only once, at the end."""
+
+    keys: List[str]
+    partials: List[Tuple[str, str, str]]  # (input_col, fn, partial_name)
+
+    def run(self, table: pa.Table) -> pa.Table:
+        spec = [(name, "sum" if f in ("count", "sum") else f)
+                for _, f, name in self.partials]
+        out = table.group_by(self.keys).aggregate(spec)
+        rename = {f"{name}_{fn}": name for (_, _, name), (_, fn)
+                  in zip(self.partials, spec)}
+        return out.rename_columns(
+            [rename.get(n, n) for n in out.column_names])
+
+
+@dataclass
 class HashJoinStep(Step):
     """Join the incoming (left bucket) table against the right bucket refs.
 
@@ -686,6 +729,57 @@ class HashJoinStep(Step):
                                    schema=self.right_schema).load()
         return table.join(right, keys=self.keys, right_keys=self.right_keys,
                           join_type=self.how)
+
+
+#: join types for which each broadcast side is semantically safe: the
+#: STREAMED side's rows are partitioned (each row seen exactly once), so its
+#: unmatched rows surface correctly; the BROADCAST side's unmatched rows
+#: would be emitted once per probe partition, so any join type that keeps
+#: them ("full outer", the broadcast side's own outer) is excluded.
+BROADCAST_RIGHT_JOIN_TYPES = frozenset(
+    ("inner", "left outer", "left semi", "left anti"))
+BROADCAST_LEFT_JOIN_TYPES = frozenset(
+    ("inner", "right outer", "right semi", "right anti"))
+
+
+@dataclass
+class BroadcastJoinStep(Step):
+    """Broadcast-hash join: stream this task's partition against an
+    executor-local hash table of the (small) broadcast side.
+
+    ``parts`` are ``(ref, offset, size)`` byte ranges of the broadcast
+    side's store blobs — replication IS the ranged-fetch plane: the first
+    task on each executor pulls every range in one batched fetch
+    (:class:`RangeRefSource`) and the built table is kept in the executor's
+    bounded broadcast cache, so sibling partitions probe it for free.
+    ``broadcast_side`` says which logical side the cached table plays:
+    ``"right"`` probes the incoming (left) partition against it, ``"left"``
+    streams right-side partitions. Either way the output schema matches the
+    bucketed :class:`HashJoinStep` exactly (left columns, then the right's
+    non-key columns)."""
+
+    parts: List[Tuple[ObjectRef, int, int]]
+    keys: List[str]
+    right_keys: List[str]
+    how: str = "inner"
+    broadcast_side: str = "right"
+    schema: Optional[bytes] = None  # broadcast side's serialized schema
+
+    def _load_small(self) -> pa.Table:
+        from raydp_tpu.etl.executor import broadcast_cache
+        key = (tuple((r.id, int(o), int(s)) for r, o, s in self.parts),
+               self.schema)
+        return broadcast_cache().get_or_load(
+            key, lambda: RangeRefSource(list(self.parts),
+                                        schema=self.schema).load())
+
+    def run(self, table: pa.Table) -> pa.Table:
+        small = self._load_small()
+        if self.broadcast_side == "right":
+            return table.join(small, keys=self.keys,
+                              right_keys=self.right_keys, join_type=self.how)
+        return small.join(table, keys=self.keys,
+                          right_keys=self.right_keys, join_type=self.how)
 
 
 @dataclass
@@ -756,6 +850,8 @@ def task_input_ids(task: Task) -> List[str]:
             ids.extend(r.id for r in step.right_refs)
             if step.right_parts is not None:
                 ids.extend(r.id for r, _, _ in step.right_parts)
+        elif isinstance(step, BroadcastJoinStep):
+            ids.extend(r.id for r, _, _ in step.parts)
         elif isinstance(step, CachedSource) and step.recover is not None:
             ids.extend(task_input_ids(step.recover))
 
@@ -788,6 +884,13 @@ def _patch_step_refs(step: Step, mapping: Dict[str, ObjectRef]) -> Step:
         if refs != step.right_refs or parts is not step.right_parts:
             return dataclasses.replace(step, right_refs=refs,
                                        right_parts=parts)
+    elif isinstance(step, BroadcastJoinStep):
+        # regenerated broadcast blobs are byte-identical (deterministic
+        # producer reruns), so offsets/sizes survive — and the fresh ids
+        # change the executor-side broadcast-cache key, forcing a refetch
+        parts = [(mapping.get(r.id, r), o, n) for r, o, n in step.parts]
+        if parts != step.parts:
+            return dataclasses.replace(step, parts=parts)
     elif isinstance(step, CachedSource) and step.recover is not None:
         recover = patch_task_refs(step.recover, mapping)
         if recover is not step.recover:
@@ -879,6 +982,17 @@ def hash_buckets(table: pa.Table, keys: Sequence[str], num_buckets: int) -> List
         else:
             h = _hash_string_like(arr)
         acc = acc * np.uint64(1000003) + h
+    # avalanche finalizer (murmur3 fmix64): the raw accumulator's LOW bits
+    # are degenerate for numeric keys — a small integer's float64 bit
+    # pattern ends in zero mantissa bits, so ``acc % 2^k`` put EVERY
+    # integer-keyed row in bucket 0 whenever the bucket count was a power
+    # of two (the default ``min(8, 2×executors)`` always is). Mixing the
+    # high bits down gives the uniform spread the skew detector and the
+    # per-bucket size index assume. Deterministic across executors, like
+    # the accumulator itself.
+    acc = acc ^ (acc >> np.uint64(33))
+    acc = acc * np.uint64(0xFF51AFD7ED558CCD)
+    acc = acc ^ (acc >> np.uint64(33))
     bucket = (acc % np.uint64(num_buckets)).astype(np.int64)
     return split_by_bucket(table, bucket, num_buckets)
 

@@ -19,10 +19,10 @@ Spec grammar (documented in doc/fault_tolerance.md)::
     RDT_FAULTS = rule (';' rule)*
     rule       = site ':' action (':' key '=' value)*
 
-    sites   : executor.run_task | shuffle.write | store.get | rpc.call
-              | estimator.epoch   (any string; sites are just names)
+    sites   : executor.run_task | shuffle.write | shuffle.fetch | store.get
+              | rpc.call | estimator.epoch   (any string; sites are just names)
     actions : crash | delay | raise | drop | connloss   (interpreted by the site)
-    keys    : nth= every= p= times= seed= match= once= ms= bucket=
+    keys    : nth= every= p= times= seed= match= once= ms= ms_per_mb= bucket=
 
 Example — crash the executor on its 3rd task, exactly once in the session::
 
@@ -63,7 +63,7 @@ KNOWN_ACTIONS = frozenset(("crash", "delay", "raise", "drop", "connloss"))
 #: a drop armed at rpc.call would claim its sentinel and inject nothing,
 #: the same silent-no-op the action-name check exists to prevent
 SITE_SPECIFIC_ACTIONS = {
-    "drop": ("shuffle.write", "store.get"),
+    "drop": ("shuffle.write", "store.get", "shuffle.fetch"),
     "connloss": ("rpc.call",),
 }
 
@@ -88,6 +88,10 @@ class FaultRule:
     match: Optional[str] = None    # substring filter on the call key
     once: Optional[str] = None     # sentinel path: at most one fire, ALL procs
     ms: float = 50.0               # delay duration for action=delay
+    #: extra delay per MiB the call site reports moving (sites that pass
+    #: ``nbytes`` to :func:`apply` — e.g. ``shuffle.fetch``); models a slow
+    #: data plane whose cost scales with payload size. 0 = fixed delay only.
+    ms_per_mb: float = 0.0
     bucket: int = 0                # which output bucket a shuffle drop targets
     #: registry position — part of the PRNG stream so two stacked rules with
     #: identical (seed, site, action) still draw independent p= schedules;
@@ -193,7 +197,7 @@ def parse_spec(spec: str, default_seed: int = 0,
             k = k.strip()
             if k in ("nth", "every", "times", "seed", "bucket"):
                 kw[k] = int(v)
-            elif k in ("p", "ms"):
+            elif k in ("p", "ms", "ms_per_mb"):
                 kw[k] = float(v)
             elif k in ("match", "once"):
                 kw[k] = v
@@ -321,15 +325,17 @@ def crash_process(code: int = CRASH_EXIT_CODE) -> None:
     os._exit(code)
 
 
-def apply(rule: FaultRule, site: str = "") -> None:
+def apply(rule: FaultRule, site: str = "", nbytes: int = 0) -> None:
     """Execute a generic action (``crash``/``delay``/``raise``). Site-specific
     actions (``drop``, ``connloss``) are interpreted by their call sites and
     ignored here, so a site can safely route every fired rule through apply()
-    after handling its own."""
+    after handling its own. ``nbytes`` lets a data-plane site scale a delay
+    by the payload it moves (``ms_per_mb=``)."""
     if rule.action == "crash":
         crash_process()
     elif rule.action == "delay":
-        time.sleep(rule.ms / 1000.0)
+        time.sleep((rule.ms + rule.ms_per_mb * nbytes / float(1 << 20))
+                   / 1000.0)
     elif rule.action == "raise":
         raise InjectedFault(
             f"injected fault at {site or rule.site} (rule {rule.action})")

@@ -610,3 +610,154 @@ def test_shuffle_write_raise_after_put_leaves_no_orphans(tmp_path,
             f"retried shuffle write leaked {after - before} store objects")
     finally:
         raydp_tpu.stop()
+
+
+# ==== adaptive execution under chaos (ISSUE 7) =====================================
+def _run_broadcast_join(app):
+    """One session running the canonical broadcast join (small dim side →
+    AQE replicates it, neither side shuffles); returns (result ipc bytes,
+    row count, report)."""
+    s = _session(app)
+    try:
+        rng = np.random.RandomState(2)
+        n = 4000
+        big = s.createDataFrame(
+            pd.DataFrame({"k": rng.randint(0, 30, n),
+                          "v": rng.randint(0, 1000, n).astype(np.int64)}),
+            num_partitions=4)
+        dim = s.createDataFrame(
+            pd.DataFrame({"k": np.arange(30),
+                          "lab": (np.arange(30) * 3).astype(np.int64)}),
+            num_partitions=2)
+        out = big.join(dim, on="k").select("k", "v", "lab")
+        n_rows = s.engine.count(out._plan)
+        table = s.engine.collect(out._plan).sort_by(
+            [("k", "ascending"), ("v", "ascending")])
+        return _ipc_bytes(table), n_rows, s.engine.shuffle_stage_report()
+    finally:
+        raydp_tpu.stop()
+
+
+def test_dropped_broadcast_replica_blob_recovery(tmp_path, monkeypatch):
+    """A broadcast side's store blob silently dropped before any executor
+    fetched its replica (``shuffle.fetch:drop`` — the first RANGED read in
+    an executor is a broadcast fetch, since a pre-shuffle broadcast join has
+    no other ranged reads): the probe task hits ObjectLostError, lineage
+    regenerates the small side's producer (ledgered under join-broadcast),
+    the BroadcastJoinStep's parts are patched to the fresh blob (a new
+    broadcast-cache key, so no executor probes stale bytes), and the join
+    result is byte-identical. The report shows both the broadcast AND the
+    recovery."""
+    base, base_n, base_rep = _run_broadcast_join("chaos-bcast-base")
+    assert sum(e.get("aqe_broadcast", 0) for e in base_rep) >= 1, base_rep
+
+    sent = str(tmp_path / "bcast-drop.sentinel")
+    monkeypatch.setenv("RDT_FAULTS", f"shuffle.fetch:drop:nth=1:once={sent}")
+    got, got_n, report = _run_broadcast_join("chaos-bcast-drop")
+    assert os.path.exists(sent), "injected broadcast-replica drop never fired"
+    assert got_n == base_n
+    assert got == base
+    assert sum(e.get("aqe_broadcast", 0) for e in report) >= 1, report
+    assert sum(e.get("recovered", 0) for e in report) >= 1, report
+    assert sum(e.get("regenerated", 0) for e in report) >= 1, report
+
+
+def _run_skew_groupagg(app):
+    """One session running a skew-split groupby (hot key ~50%, unique-first
+    chunks so row-wise partials carry the skew to the reduce side)."""
+    s = _session(app)
+    try:
+        rng = np.random.RandomState(9)
+        rows, parts = 16_000, 4
+        per = rows // parts
+        chunks, nxt = [], 1
+        for _ in range(parts):
+            nu = per // 2
+            ks = np.concatenate([np.arange(nxt, nxt + nu) * 7 + 3,
+                                 np.zeros(per - nu, dtype=np.int64)])
+            nxt += nu
+            chunks.append(pd.DataFrame(
+                {"k": ks, "v": rng.randint(0, 1000, per).astype(np.int64)}))
+        df = s.createDataFrame(pd.concat(chunks).reset_index(drop=True),
+                               num_partitions=parts)
+        out = df.groupBy("k").agg(F.sum("v").alias("sv"),
+                                  F.count("v").alias("n"))
+        table = s.engine.collect(out._plan).sort_by([("k", "ascending")])
+        return _ipc_bytes(table), s.engine.shuffle_stage_report()
+    finally:
+        raydp_tpu.stop()
+
+
+def test_dropped_split_read_source_mid_skew_recovery(tmp_path, monkeypatch):
+    """A map blob dropped exactly when a SPLIT task's ranged read touches it
+    (``shuffle.fetch:drop:nth=1`` — the split stage issues the first ranged
+    reads of the action): lineage regenerates the map producer, the split
+    task's RangeRefSource is patched (offsets survive: reruns are
+    byte-identical), and the re-planned aggregation is byte-identical with
+    both the split and the recovery in the ledger."""
+    monkeypatch.setenv("RDT_AQE_COALESCE_MIN", "0")
+    monkeypatch.setenv("RDT_AQE_SKEW_FACTOR", "2")
+    base, base_rep = _run_skew_groupagg("chaos-skew-base")
+    assert sum(e.get("aqe_split", 0) for e in base_rep) >= 1, base_rep
+
+    sent = str(tmp_path / "split-drop.sentinel")
+    monkeypatch.setenv("RDT_FAULTS", f"shuffle.fetch:drop:nth=1:once={sent}")
+    got, report = _run_skew_groupagg("chaos-skew-drop")
+    assert os.path.exists(sent), "injected split-read drop never fired"
+    assert got == base
+    assert sum(e.get("aqe_split", 0) for e in report) >= 1, report
+    assert sum(e.get("recovered", 0) for e in report) >= 1, report
+
+
+def test_broadcast_speculation_losers_leave_no_orphans(tmp_path,
+                                                       monkeypatch):
+    """The no-orphan store-count contract with BROADCAST replicas in the
+    race: a seeded one-executor straggler makes the broadcast side's
+    materialize tasks speculate; the losing copy's blob is a duplicate
+    broadcast replica that reaches no caller and must free through the
+    loser-drain path — after the action settles, the store count returns to
+    its pre-action baseline and the result matches a straggler-free run."""
+    from raydp_tpu.runtime.object_store import get_client
+
+    base, base_n, _ = _run_broadcast_join("chaos-bcast-spec-base")
+
+    app = "chaos-bcast-spec"
+    victim = f"rdt-executor-{app}-0"
+    monkeypatch.setenv("RDT_FAULTS",
+                       f"executor.run_task:delay:ms=600:match={victim}|")
+    monkeypatch.setenv("RDT_SPECULATION", "1")
+    monkeypatch.setenv("RDT_SPECULATION_QUANTILE", "0.5")
+    monkeypatch.setenv("RDT_SPECULATION_MIN_S", "0.2")
+    s = _session(app)
+    try:
+        rng = np.random.RandomState(2)
+        n = 4000
+        big = s.createDataFrame(
+            pd.DataFrame({"k": rng.randint(0, 30, n),
+                          "v": rng.randint(0, 1000, n).astype(np.int64)}),
+            num_partitions=4)
+        dim = s.createDataFrame(
+            pd.DataFrame({"k": np.arange(30),
+                          "lab": (np.arange(30) * 3).astype(np.int64)}),
+            num_partitions=2)
+        client = get_client()
+        before = client.stats()["num_objects"]
+        out = big.join(dim, on="k").select("k", "v", "lab")
+        n_rows = s.engine.count(out._plan)
+        table = s.engine.collect(out._plan).sort_by(
+            [("k", "ascending"), ("v", "ascending")])
+        report = s.engine.shuffle_stage_report()
+        assert n_rows == base_n
+        assert _ipc_bytes(table) == base
+        assert sum(e.get("aqe_broadcast", 0) for e in report) >= 1, report
+        # losing duplicates land late and free through the loser path:
+        # poll the store audit back to the pre-action baseline
+        deadline = time.time() + 30
+        while time.time() < deadline \
+                and client.stats()["num_objects"] != before:
+            time.sleep(0.2)
+        orphans = client.stats()["num_objects"] - before
+        assert orphans == 0, (
+            f"broadcast speculation races orphaned {orphans} store objects")
+    finally:
+        raydp_tpu.stop()

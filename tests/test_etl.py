@@ -71,7 +71,11 @@ def test_join(session, people):
     assert (joined[joined.city == "sf"].state == "CA").all()
 
 
-def test_repartition_and_coalesce(session):
+def test_repartition_and_coalesce(session, monkeypatch):
+    # AQE's tiny-partition coalescing deliberately fuses kilobyte-sized
+    # reduce buckets (doc/etl.md "Adaptive execution"), so the EXACT
+    # partition count only holds with it off — rows are identical either way
+    monkeypatch.setenv("RDT_ETL_AQE", "0")
     df = session.range(1000, num_partitions=2)
     rep = df.repartition(5)
     assert rep.num_partitions() == 5
@@ -79,6 +83,11 @@ def test_repartition_and_coalesce(session):
     co = rep.coalesce(2)
     assert co.num_partitions() == 2
     assert co.count() == 1000
+    # with AQE on, these tiny buckets fuse into fewer dispatches — the
+    # row-count contract (what repartition is FOR in a pipeline) survives
+    monkeypatch.setenv("RDT_ETL_AQE", "1")
+    assert 1 <= rep.num_partitions() <= 5
+    assert rep.count() == 1000
 
 
 def test_random_split_disjoint(session):

@@ -155,6 +155,30 @@ def test_apply_delay_and_raise():
         faults.apply(faults.FaultRule("s", "raise"), "s")
 
 
+def test_apply_delay_scales_with_reported_bytes():
+    """``ms_per_mb=`` scales a delay by the payload a data-plane site
+    reports (the slow-data-plane model the AQE skew bench uses): 2 MiB at
+    20ms/MiB ≈ 40ms on top of a zero fixed delay; a site that reports no
+    bytes pays only the fixed part."""
+    import time
+    rule = faults.parse_spec("shuffle.fetch:delay:ms=0:ms_per_mb=20")[0]
+    t0 = time.monotonic()
+    faults.apply(rule, "shuffle.fetch", nbytes=2 << 20)
+    assert time.monotonic() - t0 >= 0.035
+    t0 = time.monotonic()
+    faults.apply(rule, "shuffle.fetch")          # no bytes → no scaled part
+    assert time.monotonic() - t0 < 0.03
+
+
+def test_shuffle_fetch_drop_site_is_valid_and_store_sites_reject_it():
+    # shuffle.fetch interprets drop (the ranged-read loss model); arming a
+    # drop at rpc.call must still fail loudly
+    rule = faults.parse_spec("shuffle.fetch:drop:nth=1")[0]
+    assert rule.site == "shuffle.fetch" and rule.action == "drop"
+    with pytest.raises(ValueError):
+        faults.parse_spec("rpc.call:drop:nth=1")
+
+
 def test_store_get_drop_raises_object_lost(runtime):
     """The store.get injection point: a dropped blob raises the typed
     ObjectLostError AND is genuinely gone for every later reader."""

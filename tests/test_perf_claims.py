@@ -140,6 +140,7 @@ _MEASURED_RE = re.compile(
     r"([0-9][\d,.]*\s*(?:k|M)?\s*(?:%?\s*MFU|tok/s|tokens/s"
     r"|samples/s(?:/chip)?|ms/step|×\s*fewer\s+shuffled\s+bytes"
     r"|×\s*fewer\s+store\s+metadata\s+RPCs"
+    r"|×\s*fewer\s+reduce\s+dispatches"
     r"|×\s*faster\s+stage\s+wall))",
     re.I)
 

@@ -290,3 +290,44 @@ def test_loser_cache_drop_skipped_when_entry_shared():
     winner2 = {"cache_key": "k", "cache_stamp": "s1", "executor": "other"}
     pool._free_loser_result_sync(elsewhere, winner2)
     assert h.dropped == [(["k"], "s2")]
+
+
+def test_locality_weights_total_range_bytes_across_all_parts(monkeypatch):
+    """ISSUE 7 small fix: a multi-range source (a coalesced read fusing
+    several buckets, or a split portion spanning maps) must be routed by the
+    TOTAL bytes it reads across all its (ref, off, size) triples — not just
+    wherever its first ref lives. Nested part-lists (a fused group of
+    buckets) flatten into the same weighting."""
+    pool = ExecutorPool([StubExecutor(name="eA"), StubExecutor(name="eB")],
+                        hosts_by_name={"eA": "hostA", "eB": "hostB"})
+    engine = E.Engine(pool)
+
+    ra = ObjectRef(id="a" * 32, size=10)       # lives on hostA, small
+    rb1 = ObjectRef(id="b" * 32, size=4000)    # hostB, bulk of the bytes
+    rb2 = ObjectRef(id="c" * 32, size=3000)    # hostB
+
+    class _Client:
+        def locations(self, refs):
+            return {("a" * 32): "hostA", ("b" * 32): "hostB",
+                    ("c" * 32): "hostB"}
+
+    monkeypatch.setattr(E, "get_client", lambda: _Client())
+
+    # first ref on hostA, but the range bytes overwhelmingly live on hostB
+    flat = [[(ra, 0, 10), (rb1, 0, 4000), (rb2, 0, 3000)]]
+    assert engine._locality(flat) == ["eB"]
+    # nested part-lists (a coalesced multi-bucket group) weigh the same
+    nested = [[[(ra, 0, 10)], [(rb1, 0, 4000), (rb2, 0, 3000)]]]
+    assert engine._locality(nested) == ["eB"]
+    # plain refs still weight by whole-blob size
+    assert engine._locality([[ra], [rb1]]) == ["eA", "eB"]
+    # range SIZE (not the blob's) is what counts: a tiny slice of a huge
+    # blob on hostB must not outweigh real bytes on hostA
+    huge_b = ObjectRef(id="d" * 32, size=1 << 20)
+
+    class _Client2(_Client):
+        def locations(self, refs):
+            return {("a" * 32): "hostA", ("d" * 32): "hostB"}
+
+    monkeypatch.setattr(E, "get_client", lambda: _Client2())
+    assert engine._locality([[(ra, 0, 10), (huge_b, 0, 4)]]) == ["eA"]

@@ -41,6 +41,41 @@ def test_shuffle_bench_smoke(tmp_path):
     assert record["all_identical"] is True
 
 
+def test_shuffle_bench_aqe_smoke(tmp_path):
+    """The --aqe leg (benchmarks/AQE.json harness): all three adaptive
+    rules off vs on at smoke scale. Structural floors only — the broadcast
+    byte drop and the coalesce dispatch drop are deterministic; the skew
+    wall uses a loose floor (its seeded per-MB fetch delay dominates, but
+    this is a 1-core host under CI load)."""
+    out_path = tmp_path / "AQE_SMOKE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RDT_AQE_PATH=str(out_path))
+    for k in ("RDT_ETL_AQE", "RDT_AQE_BROADCAST_MAX", "RDT_AQE_SKEW_FACTOR",
+              "RDT_AQE_COALESCE_MIN", "RDT_SHUFFLE_CONSOLIDATE",
+              "RDT_FAULTS", "RDT_SPECULATION"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks", "shuffle_bench.py"),
+         "--aqe", "--smoke"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    record = json.loads(out_path.read_text())
+    assert record["metric"] == "etl_aqe" and record["smoke"]
+    assert record["all_identical"] is True
+    bc = record["configs"]["broadcast_join"]
+    assert bc["identical"], "broadcast changed the join's rows"
+    assert bc["aqe_broadcast_on"] >= 1 and bc["aqe_broadcast_off"] == 0
+    assert 0 < bc["bytes_on"] < bc["bytes_off"]
+    assert bc["reduction_x"] >= 10.0, bc
+    sk = record["configs"]["skew_groupby"]
+    assert sk["identical"], "skew split changed the groupby's rows"
+    assert sk["aqe_split_on"] >= 1 and sk["aqe_split_off"] == 0
+    assert sk["speedup_x"] >= 1.2, sk
+    co = record["configs"]["coalesce_many"]
+    assert co["identical"], "coalescing changed the repartition's rows"
+    assert co["reduce_tasks_on"] < co["reduce_tasks_off"]
+    assert co["dispatch_reduction_x"] >= 4.0, co
+
+
 def test_shuffle_bench_straggler_smoke(tmp_path):
     """The --straggler leg (benchmarks/STRAGGLER.json harness): a seeded
     one-executor delay, speculation off vs on. At smoke scale the structural

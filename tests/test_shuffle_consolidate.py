@@ -94,6 +94,10 @@ def test_groupagg_single_phase_consolidated(monkeypatch, session, wide):
 
 
 def test_join_both_sides_consolidated(monkeypatch, session, wide):
+    # AQE off: this test pins the BUCKETED shuffle-join's consolidated
+    # format (with it on, the tiny dim side broadcasts and neither side
+    # shuffles at all — covered by tests/test_aqe.py instead)
+    monkeypatch.setenv("RDT_ETL_AQE", "0")
     dim = session.createDataFrame(
         pd.DataFrame({"k": np.arange(11), "label": np.arange(11) * 3}),
         num_partitions=2)
