@@ -56,13 +56,35 @@ AQE.json``, override ``RDT_AQE_PATH``), each rule off vs on:
 - ``coalesce_many`` — reduce-task dispatch count on the 64×64 config when
   kilobyte buckets fuse into multi-range reads.
 
+A fifth leg measures the PIPELINED shuffle (``--pipeline`` →
+``benchmarks/PIPELINE.json``, override ``RDT_PIPELINE_PATH``): the same
+16-map shuffle under a seeded per-map ``executor.run_task:delay`` spread
+(every 2nd map task entering an executor sleeps — a real map tail on this
+1-core host; the ``mt-`` map-task id prefix pins the rule to the map side)
+plus a seeded per-MiB ``shuffle.fetch`` delay (the honest-data-plane
+methodology of the AQE skew leg), with ``RDT_SHUFFLE_PIPELINE`` off then
+on, recording per mode:
+
+- ``wall_barrier_s`` / ``wall_pipelined_s`` — stage wall (reduce side
+  dispatched after the barrier vs concurrently with the maps),
+- ``overlap_s`` — time reducers spent fetching/decoding BEFORE the last
+  map sealed (0 structurally in barrier mode),
+- ``first_reduce_fetch_s`` — first reduce-side fetch relative to map-stage
+  start,
+- ``speedup_x`` — wall_barrier / wall_pipelined,
+- ``identical`` — results row-for-row equal after a canonical sort,
+- ``orphans_pipelined`` — store objects left after the pipelined action
+  settles (the abort/no-orphan audit with reducers mid-stream).
+
 The byte/RPC record lands in ``benchmarks/SHUFFLE_BYTES.json`` (override:
 ``RDT_SHUFFLE_BYTES_PATH``). ``--smoke`` shrinks the data to seconds of
 wall and writes to /tmp by default so a CI smoke run cannot clobber the
-recorded artifact. The optimizer/consolidate/straggler legs pin
-``RDT_ETL_AQE=0`` so each leg measures exactly one mechanism.
+recorded artifact. The optimizer/consolidate/straggler/aqe legs pin
+``RDT_ETL_AQE=0`` and/or ``RDT_SHUFFLE_PIPELINE=0`` as needed so each leg
+measures exactly one mechanism.
 
 Run: python benchmarks/shuffle_bench.py [--smoke] [--straggler] [--aqe]
+     [--pipeline]
 """
 
 import json
@@ -96,6 +118,9 @@ def run_config(session, action, sort_keys):
     out = {}
     tables = {}
     os.environ["RDT_ETL_AQE"] = "0"
+    # pipeline off too: with AQE off the shuffles would stream, and the
+    # background map stage would confound the naive-vs-opt walls
+    os.environ["RDT_SHUFFLE_PIPELINE"] = "0"
     for mode, env in (("naive", "0"), ("opt", "1")):
         os.environ["RDT_ETL_OPTIMIZER"] = env
         assert optimizer.enabled() == (env == "1")
@@ -109,6 +134,7 @@ def run_config(session, action, sort_keys):
         out[f"wall_{mode}_s"] = round(wall, 4)
         tables[mode] = table.sort_by([(k, "ascending") for k in sort_keys])
     os.environ.pop("RDT_ETL_AQE", None)
+    os.environ.pop("RDT_SHUFFLE_PIPELINE", None)
     out["reduction_x"] = round(out["bytes_naive"] / max(out["bytes_opt"], 1), 2)
     out["identical"] = tables["naive"].equals(tables["opt"])
     out["stages_opt"] = [r["stage"] for r in
@@ -136,8 +162,11 @@ def run_consolidate_config(session, rows, maps, buckets):
     out = {"maps": maps, "buckets": buckets, "rows": rows}
     tables = {}
     # AQE off: the leg compares per-bucket vs consolidated CONTROL traffic
-    # at a fixed 64-reduce fan-in; coalescing would collapse the reduce side
+    # at a fixed 64-reduce fan-in; coalescing would collapse the reduce side.
+    # Pipeline off: it engages only WITH consolidation, which would skew the
+    # naive-vs-consolidated wall comparison (the --pipeline leg measures it)
     os.environ["RDT_ETL_AQE"] = "0"
+    os.environ["RDT_SHUFFLE_PIPELINE"] = "0"
     for mode, env in (("naive", "0"), ("consolidated", "1")):
         os.environ["RDT_SHUFFLE_CONSOLIDATE"] = env
         session.engine.reset_shuffle_stage_report()
@@ -154,6 +183,7 @@ def run_consolidate_config(session, rows, maps, buckets):
                                       ("v", "ascending")])
     os.environ.pop("RDT_SHUFFLE_CONSOLIDATE", None)
     os.environ.pop("RDT_ETL_AQE", None)
+    os.environ.pop("RDT_SHUFFLE_PIPELINE", None)
     out["rpc_reduction_x"] = round(
         out["store_rpcs_naive"] / max(out["store_rpcs_consolidated"], 1), 2)
     out["identical"] = tables["naive"].equals(tables["consolidated"])
@@ -185,8 +215,10 @@ def run_straggler_config(smoke):
         os.environ["RDT_FAULTS"] = (
             f"executor.run_task:delay:ms={delay_ms}:match={victim}|")
         os.environ["RDT_SPECULATION"] = env
-        # fixed reduce fan-in: isolate speculation from AQE coalescing
+        # fixed reduce fan-in: isolate speculation from AQE coalescing;
+        # pipeline off so the wall measures speculation alone
         os.environ["RDT_ETL_AQE"] = "0"
+        os.environ["RDT_SHUFFLE_PIPELINE"] = "0"
         # half the stage rides the straggler, so the default 0.75 completion
         # gate could never open; the min floor keeps smoke thresholds honest
         os.environ["RDT_SPECULATION_QUANTILE"] = "0.5"
@@ -219,7 +251,7 @@ def run_straggler_config(smoke):
             raydp_tpu.stop()
             for k in ("RDT_FAULTS", "RDT_SPECULATION",
                       "RDT_SPECULATION_QUANTILE", "RDT_SPECULATION_MIN_S",
-                      "RDT_ETL_AQE"):
+                      "RDT_ETL_AQE", "RDT_SHUFFLE_PIPELINE"):
                 os.environ.pop(k, None)
     out["speedup_x"] = round(out["wall_off_s"] / max(out["wall_on_s"], 1e-9),
                              2)
@@ -240,6 +272,8 @@ def run_aqe_broadcast_config(session, rows, parts):
         num_partitions=2)
     out = {"rows": rows}
     tables = {}
+    # pipeline off: the AQE-off mode would otherwise stream its shuffles
+    os.environ["RDT_SHUFFLE_PIPELINE"] = "0"
     for mode, env in (("off", "0"), ("on", "1")):
         os.environ["RDT_ETL_AQE"] = env
         session.engine.reset_shuffle_stage_report()
@@ -254,6 +288,7 @@ def run_aqe_broadcast_config(session, rows, parts):
         tables[mode] = table.sort_by([("k", "ascending"),
                                       ("c0", "ascending")])
     os.environ.pop("RDT_ETL_AQE", None)
+    os.environ.pop("RDT_SHUFFLE_PIPELINE", None)
     out["reduction_x"] = round(out["bytes_off"] / max(out["bytes_on"], 1), 2)
     out["identical"] = tables["off"].equals(tables["on"])
     return out
@@ -297,6 +332,7 @@ def run_aqe_skew_config(smoke):
         os.environ["RDT_FAULTS"] = (
             f"shuffle.fetch:delay:ms=0:ms_per_mb={ms_per_mb}")
         os.environ["RDT_SPECULATION"] = "0"
+        os.environ["RDT_SHUFFLE_PIPELINE"] = "0"
         os.environ["RDT_ETL_AQE"] = env
         os.environ["RDT_AQE_COALESCE_MIN"] = "65536"
         # 4 executors × (max_concurrency 2) = 8 overlappable fetch slots:
@@ -319,7 +355,7 @@ def run_aqe_skew_config(smoke):
         finally:
             raydp_tpu.stop()
             for k in ("RDT_FAULTS", "RDT_SPECULATION", "RDT_ETL_AQE",
-                      "RDT_AQE_COALESCE_MIN"):
+                      "RDT_AQE_COALESCE_MIN", "RDT_SHUFFLE_PIPELINE"):
                 os.environ.pop(k, None)
     out["speedup_x"] = round(out["wall_off_s"] / max(out["wall_on_s"], 1e-9),
                              2)
@@ -338,6 +374,7 @@ def run_aqe_coalesce_config(session, rows, maps, buckets):
     df = session.createDataFrame(pdf, num_partitions=maps)
     out = {"maps": maps, "buckets": buckets, "rows": rows}
     tables = {}
+    os.environ["RDT_SHUFFLE_PIPELINE"] = "0"
     for mode, env in (("off", "0"), ("on", "1")):
         os.environ["RDT_ETL_AQE"] = env
         session.engine.reset_shuffle_stage_report()
@@ -350,10 +387,112 @@ def run_aqe_coalesce_config(session, rows, maps, buckets):
         tables[mode] = table.sort_by([("k", "ascending"),
                                       ("v", "ascending")])
     os.environ.pop("RDT_ETL_AQE", None)
+    os.environ.pop("RDT_SHUFFLE_PIPELINE", None)
     out["dispatch_reduction_x"] = round(
         out["reduce_tasks_off"] / max(out["reduce_tasks_on"], 1), 2)
     out["identical"] = tables["off"].equals(tables["on"])
     return out
+
+
+def run_pipeline_config(smoke):
+    """The pipelined-shuffle leg: the same 16-map repartition with the
+    reduce side dispatched at the barrier vs as seal notifications arrive.
+    The map tail is made real with a seeded per-map delay (every 2nd map
+    task entering an executor sleeps; ``match=|mt-`` pins the rule to
+    shuffle MAP tasks — reduce tasks never match), and the reduce side's
+    byte cost with the AQE-skew-leg methodology (a seeded per-MiB
+    ``shuffle.fetch`` delay — on a 1-core host the fetch wall IS the
+    honest model of a loaded data plane). The fault spec is identical in
+    both modes, so the only variable is `RDT_SHUFFLE_PIPELINE`. AQE and
+    speculation are pinned off (orthogonal; chaos tests cover the
+    compositions)."""
+    import raydp_tpu
+    from raydp_tpu.runtime.object_store import get_client
+
+    maps, buckets = 16, 8
+    rows = maps * (1500 if smoke else 12_000)
+    map_delay_ms = 250 if smoke else 700
+    ms_per_mb = 8000 if smoke else 5000
+    out = {"maps": maps, "buckets": buckets, "rows": rows,
+           "map_delay_ms": map_delay_ms, "ms_per_mb": ms_per_mb}
+    rng = np.random.RandomState(23)
+    pdf = pd.DataFrame({"k": rng.randint(0, 1_000_000, rows),
+                        "v": rng.randint(0, 1_000_000, rows)})
+    tables = {}
+    for mode, env in (("barrier", "0"), ("pipelined", "1")):
+        os.environ["RDT_FAULTS"] = (
+            f"executor.run_task:delay:ms={map_delay_ms}:every=2:match=|mt-;"
+            f"shuffle.fetch:delay:ms=0:ms_per_mb={ms_per_mb}")
+        os.environ["RDT_SHUFFLE_PIPELINE"] = env
+        os.environ["RDT_ETL_AQE"] = "0"
+        os.environ["RDT_SPECULATION"] = "0"
+        session = raydp_tpu.init(f"pipeline_{mode}", num_executors=2,
+                                 executor_cores=2, executor_memory="1GB")
+        try:
+            df = session.createDataFrame(pdf, num_partitions=maps)
+            client = get_client()
+            before = client.stats()["num_objects"]
+            session.engine.reset_shuffle_stage_report()
+            t0 = time.perf_counter()
+            table = df.repartition(buckets).to_arrow()
+            out[f"wall_{mode}_s"] = round(time.perf_counter() - t0, 4)
+            report = session.engine.shuffle_stage_report()
+            out[f"pipelined_{mode}"] = any(e.get("pipelined")
+                                           for e in report)
+            out[f"overlap_{mode}_s"] = round(
+                sum(e.get("overlap_s", 0.0) for e in report), 4)
+            firsts = [e["first_reduce_fetch_s"] for e in report
+                      if e.get("first_reduce_fetch_s") is not None]
+            out[f"first_reduce_fetch_{mode}_s"] = \
+                round(min(firsts), 4) if firsts else None
+            # the abort/no-orphan audit with reducers mid-stream: the
+            # store count must settle back to its pre-action value
+            deadline = time.time() + 30
+            while time.time() < deadline \
+                    and client.stats()["num_objects"] != before:
+                time.sleep(0.2)
+            out[f"orphans_{mode}"] = \
+                client.stats()["num_objects"] - before
+            tables[mode] = table.sort_by([("k", "ascending"),
+                                          ("v", "ascending")])
+        finally:
+            raydp_tpu.stop()
+            for k in ("RDT_FAULTS", "RDT_SHUFFLE_PIPELINE", "RDT_ETL_AQE",
+                      "RDT_SPECULATION"):
+                os.environ.pop(k, None)
+    out["overlap_s"] = out["overlap_pipelined_s"]
+    out["first_reduce_fetch_s"] = out["first_reduce_fetch_pipelined_s"]
+    out["speedup_x"] = round(
+        out["wall_barrier_s"] / max(out["wall_pipelined_s"], 1e-9), 2)
+    out["identical"] = tables["barrier"].equals(tables["pipelined"])
+    return out
+
+
+def main_pipeline(smoke):
+    default_path = ("/tmp/PIPELINE_SMOKE.json" if smoke else
+                    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "PIPELINE.json"))
+    out_path = os.environ.get("RDT_PIPELINE_PATH", default_path)
+    record = {
+        "metric": "etl_shuffle_pipeline",
+        "unit": "wall_barrier/wall_pipelined under a seeded per-map delay "
+                "spread + per-MiB fetch delay",
+        "smoke": smoke,
+        "configs": {"pipeline": run_pipeline_config(smoke)},
+    }
+    cfg = record["configs"]["pipeline"]
+    record["value"] = cfg["speedup_x"]
+    record["all_identical"] = cfg["identical"]
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, "w") as fh:
+        json.dump(record, fh, indent=2, sort_keys=True)
+    print(json.dumps({k: v for k, v in record.items() if k != "configs"}))
+    print(f"pipeline: wall {cfg['wall_barrier_s']}s -> "
+          f"{cfg['wall_pipelined_s']}s ({cfg['speedup_x']}x), overlap "
+          f"{cfg['overlap_s']}s, first reduce fetch at "
+          f"{cfg['first_reduce_fetch_s']}s, orphans "
+          f"{cfg['orphans_pipelined']}, identical={cfg['identical']}")
+    return record
 
 
 def main_aqe(smoke):
@@ -440,6 +579,8 @@ def main():
         return main_straggler(smoke)
     if "--aqe" in sys.argv:
         return main_aqe(smoke)
+    if "--pipeline" in sys.argv:
+        return main_pipeline(smoke)
     rows = 4_000 if smoke else 400_000
     parts = 4 if smoke else 8
     default_path = ("/tmp/SHUFFLE_BYTES_SMOKE.json" if smoke else

@@ -113,6 +113,15 @@ def _consolidate_enabled() -> bool:
     return v not in ("0", "false", "off", "no")
 
 
+def _pipeline_enabled() -> bool:
+    """Pipelined (push-based) shuffle kill switch, default ON; read per
+    action like ``RDT_ETL_AQE``. The mode requires the consolidated
+    per-bucket index, so ``RDT_SHUFFLE_CONSOLIDATE=0`` cleanly disables it
+    too (doc/etl.md "Pipelined shuffle")."""
+    v = os.environ.get("RDT_SHUFFLE_PIPELINE", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
 def _free_result_refs(results: Sequence[Optional[Dict[str, Any]]]) -> None:
     """Free every output in a failed stage's completed results — they will
     never reach a caller, so left alone they would orphan in the store."""
@@ -217,6 +226,82 @@ class _Producer:
         self.entry: Optional[Dict[str, Any]] = None
 
 
+class _StreamStageRec:
+    """Driver-side record of ONE pipelined shuffle stage: the background
+    thread running its map stage, and the seals observed so far (what the
+    driver itself published — only winning attempts' results reach it, so a
+    speculation loser's seal never exists). ``seals`` feeds locality
+    re-weighting for streaming reducers and the post-stage resolution of
+    streaming sources into concrete ranges (cache recover recipes)."""
+
+    def __init__(self, stage_key: str, label: str, num_maps: int):
+        self.stage_key = stage_key
+        self.label = label
+        self.num_maps = num_maps
+        self.start_ts = time.time()
+        #: per map: (consolidated ref, per-bucket (off, size, rows) index)
+        #: of the LATEST generation (a regenerated producer re-seals here)
+        self.seals: List[Optional[Tuple[ObjectRef, list]]] = [None] * num_maps
+        self.gens = [0] * num_maps
+        self.thread: Optional[threading.Thread] = None
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.results: Optional[List[Dict[str, Any]]] = None
+        #: THIS stage's ledger entry, bound at _record_stage time —
+        #: consumer attribution goes here, never through the label map
+        #: (two same-label pipelined stages can be live concurrently)
+        self.entry: Optional[Dict[str, Any]] = None
+        self._lock = threading.Lock()
+
+    def publish(self, map_id: int, ref: ObjectRef, index) -> None:
+        """Record + push one seal notification (generation bumps on every
+        publish, so a re-seal after lineage regeneration supersedes)."""
+        with self._lock:
+            self.gens[map_id] += 1
+            gen = self.gens[map_id]
+            self.seals[map_id] = (ref, list(index))
+        get_client().stream_publish(self.stage_key, map_id, gen, ref.id,
+                                    int(ref.size or 0), list(index))
+
+    def parts_for_bucket(self, bucket: int, sealed_only: bool = False
+                         ) -> List[Tuple[ObjectRef, int, int]]:
+        """This bucket's (ref, off, size) ranges from the seals seen so far
+        (``sealed_only``) or from the COMPLETE stage (raises when a map has
+        not sealed — resolution must never bake in a partial read)."""
+        out = []
+        with self._lock:
+            for i, seal in enumerate(self.seals):
+                if seal is None:
+                    if sealed_only:
+                        continue
+                    raise RuntimeError(
+                        f"stream stage {self.label} incomplete: map {i} "
+                        "has not sealed")
+                ref, index = seal
+                off, size = int(index[bucket][0]), int(index[bucket][1])
+                out.append((ref, off, size))
+        return out
+
+
+class _StreamBucket:
+    """Driver-side placeholder for one reduce bucket of a pipelined stage —
+    the barrier mode's ``(ref, off, size)`` triples do not exist yet. Never
+    pickled: its executor-side twin is :class:`tasks.StreamingRangeSource`."""
+
+    __slots__ = ("rec", "bucket")
+
+    def __init__(self, rec: _StreamStageRec, bucket: int):
+        self.rec = rec
+        self.bucket = bucket
+
+    def source(self, schema: Optional[bytes]) -> "T.StreamingRangeSource":
+        return T.StreamingRangeSource(self.rec.stage_key, self.bucket,
+                                      self.rec.num_maps, schema=schema)
+
+    def parts_so_far(self) -> List[Tuple[ObjectRef, int, int]]:
+        return self.rec.parts_for_bucket(self.bucket, sealed_only=True)
+
+
 class _ActionTemps(list):
     """Per-action intermediate registry: the list half is the free-at-action-
     end set (what ``temps`` always was); ``lineage`` maps every intermediate
@@ -234,6 +319,52 @@ class _ActionTemps(list):
         #: the engine deque), so recovery attribution lands on this action's
         #: stage even when a concurrent action logged the same label later
         self.stage_entries: Dict[str, Dict[str, Any]] = {}
+        #: pipelined map stages launched by this action (joined + their seal
+        #: streams closed before the action frees its temps), by UNIQUE
+        #: stage key — labels repeat within one action, keys never do
+        self.streams: List[_StreamStageRec] = []
+        self.stream_by_key: Dict[str, _StreamStageRec] = {}
+        #: consolidated-blob oid → (stream rec, map_id): which publication a
+        #: regenerated producer must RE-SEAL (same map_id, next generation)
+        self.stream_pubs: Dict[str, Tuple[_StreamStageRec, int]] = {}
+        #: guards ref_patches: with pipelining, a background map stage's
+        #: recovery and the main thread's reduce-stage recovery can patch
+        #: the SAME action concurrently (single-threaded before this)
+        self._patch_lock = threading.Lock()
+
+    def close_streams(self) -> None:
+        """Join every pipelined map stage's background thread (their outputs
+        are registered here and must not be freed under running writers),
+        then drop the seal-stream ledgers — a drain-abandoned reducer still
+        polling gets an abort instead of waiting forever."""
+        if not self.streams:
+            return
+        streams, self.streams = self.streams, []
+        for rec in streams:
+            if rec.thread is not None:
+                rec.thread.join()
+            if rec.error is not None:
+                logger.warning("pipelined map stage %r failed: %s",
+                               rec.label, rec.error)
+        try:
+            get_client().stream_close([rec.stage_key for rec in streams])
+        except Exception:
+            pass
+
+    def resolve_streams(self, task: T.Task) -> T.Task:
+        """Rewrite a task's streaming sources into concrete ranged reads
+        from the completed stages' seals — for recipes serialized to outlive
+        this action (the stream ledger closes with it)."""
+        if not self.stream_by_key:
+            return task
+
+        def _resolver(stage_key: str, bucket: int):
+            rec = self.stream_by_key.get(stage_key)
+            if rec is None:
+                raise RuntimeError(f"unknown stream stage {stage_key}")
+            return rec.parts_for_bucket(bucket)
+
+        return T.resolve_stream_sources(task, _resolver)
 
     def apply_patches(self, mapping: Dict[str, ObjectRef]) -> None:
         """Fold a recovery round's old-id → fresh-ref mapping into the
@@ -241,10 +372,11 @@ class _ActionTemps(list):
         round's patch target may ITSELF be what just got regenerated, and
         anything serialized later (cache recover recipes) must point at the
         live blob, not a dead intermediate generation."""
-        for k, v in self.ref_patches.items():
-            if v.id in mapping:
-                self.ref_patches[k] = mapping[v.id]
-        self.ref_patches.update(mapping)
+        with self._patch_lock:
+            for k, v in self.ref_patches.items():
+                if v.id in mapping:
+                    self.ref_patches[k] = mapping[v.id]
+            self.ref_patches.update(mapping)
 
 
 def _root_limit(node: P.PlanNode) -> Optional[int]:
@@ -257,11 +389,13 @@ def _root_limit(node: P.PlanNode) -> Optional[int]:
 
 
 # deterministic application failures: retrying replays the same exception, so
-# fail fast with the original error instead of burning the retry budget
+# fail fast with the original error instead of burning the retry budget.
+# ShuffleStreamAborted is deterministic too: a reducer polling an aborted
+# seal stream replays the abort (which carries the map stage's real error).
 _NO_RETRY_EXC_TYPES = {
     "KeyError", "ValueError", "TypeError", "AttributeError", "IndexError",
     "ZeroDivisionError", "ArrowInvalid", "ArrowNotImplementedError",
-    "ArrowKeyError", "ArrowTypeError",
+    "ArrowKeyError", "ArrowTypeError", "ShuffleStreamAborted",
 }
 
 
@@ -346,6 +480,7 @@ class ExecutorPool:
         max_inflight_per_executor: int = 4,
         payloads: Optional[Sequence[bytes]] = None,
         sched_stats: Optional[Dict[str, Any]] = None,
+        on_result: Optional[Any] = None,
     ) -> List[Dict[str, Any]]:
         """Run tasks, preserving order of results; blocks until all complete.
 
@@ -372,7 +507,13 @@ class ExecutorPool:
         ``sched_stats``, when given, is updated in place with
         ``speculated`` / ``speculation_won`` counters and a
         ``per_executor_busy`` map (executor display name → peak in-flight
-        during this call), merging across calls."""
+        during this call), merging across calls.
+
+        ``on_result(i, result)`` fires as EACH task's winning result lands
+        (index into ``tasks``) — the pipelined shuffle's seal-notification
+        hook: the driver publishes a map's consolidated blob the moment it
+        is decided, so only winners ever seal. Callback errors are logged,
+        never fail the stage."""
         n = len(tasks)
         results: List[Optional[Dict[str, Any]]] = [None] * n
         attempts = [0] * n
@@ -601,6 +742,13 @@ class ExecutorPool:
                         results[i] = r
                         done_cnt += 1
                         durations.append(time.monotonic() - at.started)
+                        if on_result is not None:
+                            try:
+                                on_result(i, r)
+                            except Exception:
+                                logger.warning(
+                                    "task-result callback failed for %s",
+                                    tasks[i].task_id, exc_info=True)
                         if i in speculated:
                             r["_speculated"] = 1
                             if at.backup:
@@ -810,7 +958,8 @@ class Engine:
     def _record_stage(self, label: str, results: Sequence[Dict[str, Any]],
                       num_buckets: int,
                       temps: Optional[List[ObjectRef]] = None,
-                      sched_stats: Optional[Dict[str, Any]] = None) -> None:
+                      sched_stats: Optional[Dict[str, Any]] = None,
+                      pipelined: bool = False) -> None:
         """Aggregate map-task shuffle counters into one stage entry and emit
         a driver-side trace span carrying the totals as args."""
         rows = sum(int(r.get("num_rows", 0)) for r in results)
@@ -847,6 +996,14 @@ class Engine:
                  # broadcast, skewed buckets split, and buckets fused away
                  # by coalescing (all 0 when AQE is off or no rule fired)
                  "aqe_broadcast": 0, "aqe_split": 0, "aqe_coalesced": 0,
+                 # pipelined-shuffle accounting: was this stage's reduce
+                 # side dispatched concurrently with the maps; how long
+                 # reducers spent fetching/decoding BEFORE the last map
+                 # sealed (the measured overlap); and how soon after the
+                 # map stage began the first reduce-side fetch started
+                 # (reduce-side numbers fold in via Task.consumes_stage)
+                 "pipelined": pipelined, "overlap_s": 0.0,
+                 "first_reduce_fetch_s": None,
                  # lineage-recovery accounting: blobs regenerated for this
                  # stage's intermediates, and how many recovery events ran
                  "regenerated": 0, "recovered": 0}
@@ -868,6 +1025,7 @@ class Engine:
                             bytes_in=bytes_in, rows_shuffled=rows,
                             bytes_shuffled=nbytes):
             pass
+        return entry
 
     def shuffle_stage_report(self) -> List[Dict[str, Any]]:
         """Per-stage shuffle ledger: one dict per wide-op stage executed by
@@ -891,7 +1049,16 @@ class Engine:
         the pre-shuffle form; a post-map conversion marks the map stage it
         measured), skewed buckets split across extra reduce tasks, and
         reduce buckets fused away by tiny-partition coalescing (all 0 with
-        ``RDT_ETL_AQE=0`` or when no rule fired). ``regenerated`` counts intermediate blobs rebuilt
+        ``RDT_ETL_AQE=0`` or when no rule fired). ``pipelined`` marks a
+        stage whose reduce side was dispatched concurrently with its maps
+        (push-based shuffle, ``RDT_SHUFFLE_PIPELINE``); ``overlap_s`` is the
+        total time its reducers spent fetching/decoding BEFORE the last map
+        sealed and ``first_reduce_fetch_s`` how soon after the map stage
+        began the first reduce-side fetch started (False/0.0/None on a
+        barrier-mode stage; first_reduce_fetch_s compares the driver's
+        clock against the executor's ``time.time()``, so on a MULTI-host
+        pool it is subject to cross-machine clock skew — overlap_s is
+        executor-local and skew-free). ``regenerated`` counts intermediate blobs rebuilt
         through lineage recovery after a store loss, ``recovered`` the
         recovery events that rebuilt them (0/0 on a fault-free run)."""
         with self._report_lock:
@@ -920,6 +1087,8 @@ class Engine:
                          "per_executor_busy": {},
                          "aqe_broadcast": 0, "aqe_split": 0,
                          "aqe_coalesced": 0,
+                         "pipelined": False, "overlap_s": 0.0,
+                         "first_reduce_fetch_s": None,
                          "regenerated": 0, "recovered": 0}
                 self._stage_reports.append(entry)
                 temps.stage_entries[prod.label] = entry
@@ -970,7 +1139,12 @@ class Engine:
         """Reader step for one reduce bucket: whole-blob refs decode through
         :class:`tasks.ArrowRefSource` as always; byte-range triples (the
         consolidated format) through :class:`tasks.RangeRefSource` — with
-        legacy refs normalized to full-blob ranges when a stage mixes both."""
+        legacy refs normalized to full-blob ranges when a stage mixes both.
+        A pipelined stage's bucket is a :class:`_StreamBucket` placeholder
+        and reads through :class:`tasks.StreamingRangeSource` instead."""
+        for x in bucket:
+            if isinstance(x, _StreamBucket):
+                return x.source(schema)
         if any(isinstance(x, tuple) for x in bucket):
             return T.RangeRefSource(Engine._as_parts(bucket), schema=schema)
         return T.ArrowRefSource(list(bucket), schema=schema)
@@ -978,9 +1152,15 @@ class Engine:
     def _bucket_task(self, bucket: Sequence[Any], schema: Optional[bytes],
                      steps: Optional[List[T.Step]], label: str) -> T.Task:
         """A reduce task over one bucket, tagged with the stage it consumes
-        so its store-RPC counters land on that stage's ledger entry."""
+        so its store-RPC counters land on that stage's ledger entry — and,
+        when that stage is pipelined, with its UNIQUE stream key (labels
+        repeat within one action, stream keys never do)."""
         task = self._task(self._bucket_source(bucket, schema), steps)
         task.consumes_stage = label
+        for x in bucket:
+            if isinstance(x, _StreamBucket):
+                task.consumes_stream = x.rec.stage_key
+                break
         return task
 
     # ---- adaptive query execution (AQE) -------------------------------------
@@ -1115,6 +1295,11 @@ class Engine:
 
     @staticmethod
     def _free(temps: List[ObjectRef]) -> None:
+        if isinstance(temps, _ActionTemps):
+            # join pipelined map stages FIRST: their outputs register here
+            # as they seal, and freeing under still-running writers would
+            # orphan whatever lands after the sweep
+            temps.close_streams()
         if temps:
             try:
                 get_client().free(temps)
@@ -1150,6 +1335,7 @@ class Engine:
                    temps: Optional[List[ObjectRef]] = None,
                    lineage_label: Optional[str] = None,
                    sched_stats: Optional[Dict[str, Any]] = None,
+                   on_task_result: Optional[Any] = None,
                    _depth: int = 0) -> List[Dict[str, Any]]:
         """``pool.run_tasks`` with lineage recovery: on a lost-blob failure,
         re-execute the producers of the lost intermediates (transitively,
@@ -1161,7 +1347,12 @@ class Engine:
         ``lineage_label`` ledgers the stage's own outputs AFTER it succeeds —
         recorded here, not by the caller, so the recipes carry any ref
         patches recovery applied (a recipe referencing an already-dead input
-        id would force a pointless transitive round later)."""
+        id would force a pointless transitive round later).
+
+        ``on_task_result(i, task, task_bytes, result)`` fires once per task
+        index as its winning result lands (the pipelined shuffle's
+        seal-notification hook; ``task_bytes`` is the dispatch payload so an
+        incremental lineage ledger costs no extra serialization)."""
         tasks = list(tasks)
         results: List[Optional[Dict[str, Any]]] = [None] * len(tasks)
         rounds = _recovery_rounds() \
@@ -1172,6 +1363,19 @@ class Engine:
         # (the blobs must match what actually ran / what a rerun would read)
         blobs: Optional[List[Optional[bytes]]] = \
             [None] * len(tasks) if lineage_label is not None else None
+        notified = [False] * len(tasks)
+
+        def _notify(i: int, r: Dict[str, Any]) -> None:
+            if on_task_result is None or notified[i]:
+                return
+            notified[i] = True
+            try:
+                on_task_result(i, tasks[i],
+                               blobs[i] if blobs is not None else None, r)
+            except Exception:
+                logger.warning("stage result hook failed for task %s",
+                               tasks[i].task_id, exc_info=True)
+
         try:
             while True:
                 todo = [i for i, r in enumerate(results) if r is None]
@@ -1181,12 +1385,16 @@ class Engine:
                     for i, t in enumerate(tasks):
                         if blobs[i] is None:
                             blobs[i] = cloudpickle.dumps(t)
+                cb = None
+                if on_task_result is not None:
+                    def cb(j, r, _todo=todo):
+                        _notify(_todo[j], r)
                 try:
                     out = self.pool.run_tasks(
                         [tasks[i] for i in todo], sub_pref,
                         payloads=[blobs[i] for i in todo]
                         if blobs is not None else None,
-                        sched_stats=sched_stats)
+                        sched_stats=sched_stats, on_result=cb)
                     for i, r in zip(todo, out):
                         results[i] = r
                     if lineage_label is not None:
@@ -1201,6 +1409,7 @@ class Engine:
                         for i, r in zip(todo, e.partial):
                             if r is not None:
                                 results[i] = r
+                                _notify(i, r)
                     if attempt >= rounds or not e.lost_ids:
                         raise
                     lost = self._expand_lost(e.lost_ids, tasks, results,
@@ -1237,23 +1446,63 @@ class Engine:
         that themselves end in a SHUFFLE write are skipped — their counters
         already landed on the stage they PRODUCE via ``_record_stage`` (one
         task, one entry; a join reduce reads both sides but is attributed to
-        the left label it was tagged with)."""
+        the left label it was tagged with — its pipelined overlap stats
+        follow the same convention, so a pipelined join's right-stream
+        overlap folds into the join-left entry: per-stage splits are coarse
+        for joins, sums across entries exact)."""
         if not isinstance(temps, _ActionTemps):
             return
+        # a pipelined stage's ledger entry is recorded by ITS background
+        # thread when the map stage returns; reduce tasks can complete (and
+        # land here) a beat earlier — wait for the entry before attributing.
+        # Keyed on the UNIQUE stream key, never the label (labels repeat
+        # within one action — a.join(b).join(c) runs "join-left" twice and
+        # a label lookup would hand a cascaded stage its OWN rec, which this
+        # thread can never see done: self-deadlock until the timeout)
+        cur_thread = threading.current_thread()
+        for key in {getattr(t, "consumes_stream", None) for t in tasks}:
+            rec = temps.stream_by_key.get(key) if key else None
+            if rec is not None and rec.thread is not cur_thread:
+                rec.done.wait(timeout=300.0)
         with self._report_lock:
             for task, r in zip(tasks, results):
                 label = getattr(task, "consumes_stage", None)
-                if label is None or r is None or task.output == T.SHUFFLE:
+                if label is None or r is None:
                     continue
-                entry = temps.stage_entries.get(label)
-                if entry is not None:
-                    entry["meta_rpcs"] += int(r.get("meta_rpcs", 0))
-                    entry["fetch_rpcs"] += int(r.get("fetch_rpcs", 0))
-                    # reduce-side speculation lands on the stage the task
-                    # consumed, same attribution as its store RPCs
-                    entry["speculated"] += int(r.get("_speculated", 0))
-                    entry["speculation_won"] += \
-                        int(r.get("_speculation_won", 0))
+                # a pipelined stage's entry is bound to its rec — the label
+                # map would misroute stats when two same-label stages are
+                # live concurrently (a later _record_stage overwrites the
+                # shared stage_entries[label] slot)
+                rec = temps.stream_by_key.get(
+                    getattr(task, "consumes_stream", None) or "")
+                entry = rec.entry if rec is not None \
+                    and rec.entry is not None \
+                    else temps.stage_entries.get(label)
+                if entry is None:
+                    continue
+                # pipelined-shuffle overlap folds in regardless of the
+                # task's own output mode (a downstream SHUFFLE map reading
+                # a pipelined stage still overlapped THAT stage's tail)
+                ov = float(r.get("stream_overlap_s", 0) or 0)
+                if ov:
+                    entry["overlap_s"] = entry.get("overlap_s", 0.0) + ov
+                ts = r.get("stream_first_fetch_ts")
+                if ts is not None and rec is not None:
+                    rel = max(0.0, float(ts) - rec.start_ts)
+                    cur = entry.get("first_reduce_fetch_s")
+                    entry["first_reduce_fetch_s"] = \
+                        rel if cur is None else min(cur, rel)
+                if task.output == T.SHUFFLE:
+                    # RPC/speculation counters already landed on the stage
+                    # this task PRODUCES via _record_stage
+                    continue
+                entry["meta_rpcs"] += int(r.get("meta_rpcs", 0))
+                entry["fetch_rpcs"] += int(r.get("fetch_rpcs", 0))
+                # reduce-side speculation lands on the stage the task
+                # consumed, same attribution as its store RPCs
+                entry["speculated"] += int(r.get("_speculated", 0))
+                entry["speculation_won"] += \
+                    int(r.get("_speculation_won", 0))
 
     @staticmethod
     def _expand_lost(lost_ids: Sequence[str], tasks: Sequence[T.Task],
@@ -1341,6 +1590,34 @@ class Engine:
                 sub = dict(zip(prod.outputs, new_refs))
                 mapping.update(sub)
                 temps.apply_patches(sub)
+                # pipelined stages: a regenerated producer RE-SEALS under
+                # its map_id with the next generation, so in-flight and
+                # resubmitted streaming reducers read the fresh blob (the
+                # stale range's ObjectLostError is what got us here)
+                for old_id, new_ref in sub.items():
+                    pub = temps.stream_pubs.pop(old_id, None)
+                    if pub is None:
+                        continue
+                    srec, map_id = pub
+                    temps.stream_pubs[new_ref.id] = (srec, map_id)
+                    try:
+                        index = res.get("bucket_index")
+                        if not index:
+                            # an index-less rerun result can never serve
+                            # ranged readers: abort with the real cause
+                            # instead of publishing an empty index every
+                            # poll would trip over (same shape as the
+                            # missing-consolidated_ref abort)
+                            get_client().stream_abort(
+                                srec.stage_key,
+                                f"regenerated map {map_id} returned no "
+                                "bucket index")
+                        else:
+                            srec.publish(map_id, new_ref, index)
+                    except Exception:
+                        logger.warning("re-seal of regenerated map %d "
+                                       "(stage %r) failed", map_id,
+                                       srec.label, exc_info=True)
                 self._note_recovery(prod, len(ids), temps)
                 # the rerun re-ledgered fresh _Producer objects for its
                 # outputs; inherit the stage binding so a SECOND loss of a
@@ -1434,10 +1711,15 @@ class Engine:
             # recover recipes are serialized AFTER the stage so they carry
             # any ref patches in-stage lineage recovery applied — a recipe
             # pointing at a pre-recovery (dead) blob id would fail every
-            # future cache miss
+            # future cache miss. Streaming sources resolve to concrete
+            # ranged reads first: the seal-stream ledger closes with this
+            # action, and the cache stage's completion guarantees every map
+            # has sealed (their blobs stay pinned with the frame)
             recover_blobs = [
                 cloudpickle.dumps(T.patch_task_refs(
-                    t.with_output(output=T.RETURN_REF), temps.ref_patches))
+                    temps.resolve_streams(
+                        t.with_output(output=T.RETURN_REF)),
+                    temps.ref_patches))
                 for t in tasks
             ]
         except BaseException:
@@ -1455,6 +1737,12 @@ class Engine:
                 except Exception:
                     pass
             raise
+        # the success path keeps temps pinned (recipes reference them), so
+        # the usual _free won't run — the seal-stream ledgers must still
+        # close with the action (recipes were resolved to concrete ranges
+        # above; an unclosed stage would leak in the head ledger and a
+        # drain-abandoned straggler would never get its close-abort)
+        temps.close_streams()
         executors = [r["executor"] for r in results]
         schema = results[0]["schema"] if results else None
         # temps stay pinned: the lineage recipes reference them (plain list —
@@ -1482,21 +1770,20 @@ class Engine:
         try:
             nb = max(1, len(refs))
             base = 0 if seed is None else int(seed)
+            consolidate = _consolidate_enabled()
             map_tasks = [
                 self._task(T.ArrowRefSource([r], schema=schema_bytes))
                 .with_output(output=T.SHUFFLE, num_buckets=nb,
                              shuffle_seed=(base * 1_000_003 + i) & 0x7FFFFFFF,
-                             shuffle_consolidate=_consolidate_enabled(),
+                             shuffle_consolidate=consolidate,
                              owner=self.owner)
                 for i, r in enumerate(refs)
             ]
-            sstats: Dict[str, Any] = {}
-            results = self._run_stage(
-                map_tasks, self._locality([[r] for r in refs]), temps,
-                lineage_label="random-shuffle", sched_stats=sstats)
-            self._record_stage("random-shuffle", results, nb, temps,
-                               sched_stats=sstats)
-            buckets = self._gather_buckets(results, nb, temps)
+            # random-shuffle is never AQE-re-planned: pipelines under AQE
+            buckets, _ = self._dispatch_shuffle_stage(
+                map_tasks, self._locality([[r] for r in refs]), nb,
+                "random-shuffle", temps, aqe_capable=False,
+                consolidate=consolidate)
             reduce_tasks = [
                 self._bucket_task(bucket, schema_bytes,
                                   [T.LocalShuffleStep(
@@ -1628,7 +1915,11 @@ class Engine:
         fusing several buckets): EVERY range contributes its own byte
         weight, so a multi-range source is routed by the total bytes it
         reads across all its (ref, off, size) triples — not just wherever
-        its first ref happens to live."""
+        its first ref happens to live. A streaming reducer's
+        :class:`_StreamBucket` expands to the ranges of the seals seen SO
+        FAR — early reducers re-weight from partial knowledge instead of
+        dispatching preference-free (no seals yet → genuinely no
+        preference)."""
         if not self.pool.multi_host():
             return [None] * len(ref_lists)
 
@@ -1636,6 +1927,8 @@ class Engine:
             for item in items:
                 if isinstance(item, list):
                     yield from _flat(item)
+                elif isinstance(item, _StreamBucket):
+                    yield from item.parts_so_far()
                 else:
                     yield item
 
@@ -1707,32 +2000,126 @@ class Engine:
                 tasks.append(self._task(T.ParquetSource(path, None, node.columns)))
         return tasks, [None] * len(tasks)
 
-    # ---- wide operators -----------------------------------------------------
-    def _shuffle_children(self, node: P.PlanNode, num_buckets: int,
-                          keys: Optional[List[str]], temps: List[ObjectRef],
-                          range_key=None, pre_steps: Optional[List[T.Step]] = None,
-                          label: str = "shuffle",
-                          stats: Optional[Dict[str, Any]] = None,
-                          ) -> Tuple[List[List[ObjectRef]], Optional[bytes]]:
-        """Execute ``node`` with SHUFFLE output; transpose map×bucket → bucket×map.
+    # ---- pipelined (push-based) shuffle -------------------------------------
+    def _stream_ok(self, temps, aqe_capable: bool,
+                   consolidate: bool) -> bool:
+        """Whether a shuffle stage may pipeline its reduce side (doc/etl.md
+        "Pipelined shuffle"). Requires the consolidated per-bucket index and
+        an action ledger; and the AQE interaction rule is **AQE wins**: a
+        stage AQE may re-plan (groupagg/join/distinct/repartition —
+        post-map broadcast, skew split, and coalescing all need the full
+        map-size picture) runs in barrier mode whenever ``RDT_ETL_AQE`` is
+        on, while never-re-planned stages (window, sort-range,
+        random-shuffle) pipeline regardless."""
+        return (_pipeline_enabled() and consolidate
+                and isinstance(temps, _ActionTemps)
+                and not (aqe_capable and O.aqe_enabled()))
 
-        ``pre_steps`` run on each map task AFTER the narrow chain and BEFORE
-        bucketing (the hook map-side partial aggregation uses); ``label`` names
-        the stage in the engine's shuffle ledger. ``stats``, when given, is
-        filled with the stage's measured ``bytes_shuffled`` — the number the
-        AQE post-map broadcast rule re-plans on."""
-        tasks, preferred = self._compile(node, temps)
-        extra = list(pre_steps or [])
-        tasks = [t.with_output(steps=t.steps + extra,
-                               shuffle_pre_steps=len(extra),
-                               output=T.SHUFFLE, num_buckets=num_buckets,
-                               shuffle_keys=keys, range_key=range_key,
-                               shuffle_consolidate=_consolidate_enabled(),
-                               owner=self.owner)
-                 for t in tasks]
+    def _stream_shuffle_stage(self, tasks: List[T.Task],
+                              preferred: Optional[Sequence[Optional[str]]],
+                              num_buckets: int, label: str,
+                              temps: "_ActionTemps") -> List[List[Any]]:
+        """Launch a shuffle map stage WITHOUT a barrier: the stage runs on a
+        background thread and this returns immediately with per-bucket
+        :class:`_StreamBucket` placeholders, so the caller's reduce tasks
+        compile and dispatch while the maps are still running. As each map's
+        winning result lands, the driver ledgers its lineage and publishes
+        the seal ``(map_id, ref, per-bucket index)`` to the store server's
+        stream ledger — already-running reducers fetch + decode that portion
+        immediately. A failed map stage aborts the stream (reducers fail
+        fast, typed) ; the thread is joined and the ledger closed by the
+        action's ``_free`` via :meth:`_ActionTemps.close_streams`."""
+        client = get_client()
+        stage_key = f"ss-{uuid.uuid4().hex[:12]}"
+        rec = _StreamStageRec(stage_key, label, len(tasks))
+        client.stream_begin(stage_key, len(tasks))
+        temps.streams.append(rec)
+        temps.stream_by_key[stage_key] = rec
+
+        def _on_map_result(i: int, task: T.Task, tbytes: Optional[bytes],
+                           r: Dict[str, Any]) -> None:
+            cref = r.get("consolidated_ref")
+            if cref is None:
+                # never expected (streaming requires shuffle_consolidate on
+                # every task): abort rather than hang the reducers
+                client.stream_abort(stage_key,
+                                    f"map {task.task_id} returned a "
+                                    "non-consolidated result")
+                return
+            temps.append(cref)
+            # incremental lineage: a reducer can lose this blob while the
+            # map stage is still running — the recipe must already be
+            # ledgered (the stage-end _record_lineage re-ledgers, harmless)
+            prod = _Producer(tbytes if tbytes is not None
+                             else cloudpickle.dumps(task), [cref.id], label)
+            temps.lineage[cref.id] = prod
+            temps.stream_pubs[cref.id] = (rec, i)
+            try:
+                rec.publish(i, cref, r["bucket_index"])
+            except BaseException as e:  # noqa: BLE001 - reducers must learn
+                # a seal that never reaches the ledger would hang every
+                # reducer in an unbounded poll loop: abort the stream so
+                # the stage fails typed instead of the action never
+                # returning
+                logger.warning("seal publish for map %d (stage %r) "
+                               "failed: %s", i, label, e)
+                try:
+                    client.stream_abort(
+                        stage_key, f"seal publish failed for map "
+                        f"{task.task_id}: {type(e).__name__}: {e}")
+                except Exception:
+                    pass
+
         sstats: Dict[str, Any] = {}
-        results = self._run_stage(tasks, preferred, temps, lineage_label=label,
-                                  sched_stats=sstats)
+
+        def _runner():
+            try:
+                results = self._run_stage(tasks, preferred, temps,
+                                          lineage_label=label,
+                                          sched_stats=sstats,
+                                          on_task_result=_on_map_result)
+                rec.results = results
+                rec.entry = self._record_stage(label, results, num_buckets,
+                                               temps, sched_stats=sstats,
+                                               pipelined=True)
+            except BaseException as e:  # noqa: BLE001 - reducers must learn
+                rec.error = e
+                try:
+                    client.stream_abort(stage_key,
+                                        f"{type(e).__name__}: {e}")
+                except Exception:
+                    pass
+            finally:
+                rec.done.set()
+
+        rec.thread = threading.Thread(target=_runner, daemon=True,
+                                      name=f"rdt-stream-map-{label}")
+        rec.thread.start()
+        return [[_StreamBucket(rec, b)] for b in range(num_buckets)]
+
+    def _dispatch_shuffle_stage(self, tasks: List[T.Task],
+                                preferred: Optional[Sequence[Optional[str]]],
+                                num_buckets: int, label: str, temps,
+                                aqe_capable: bool, consolidate: bool,
+                                stats: Optional[Dict[str, Any]] = None,
+                                ) -> Tuple[List[List[Any]], Optional[bytes]]:
+        """Run a built shuffle map stage, streamed or barrier — the ONE
+        place the mt- map-task-id convention, the :meth:`_stream_ok` gate,
+        and the barrier fallback live (every shuffle flavor routes through
+        here, so their semantics cannot diverge). Returns (buckets, schema);
+        a streamed stage returns :class:`_StreamBucket` placeholders and
+        ``None`` schema (streamed reads decode it from the blobs' IPC
+        streams), and ``stats`` stays unfilled (only AQE — which forces
+        barrier — consumes it)."""
+        # shuffle MAP task ids are prefixed so a fault/chaos schedule can
+        # pin the map side (`executor.run_task` key match=|mt-)
+        tasks = [t.with_output(task_id=f"mt-{t.task_id}") for t in tasks]
+        if tasks and self._stream_ok(temps, aqe_capable, consolidate):
+            return self._stream_shuffle_stage(tasks, preferred, num_buckets,
+                                              label, temps), None
+        sstats: Dict[str, Any] = {}
+        results = self._run_stage(tasks, preferred, temps,
+                                  lineage_label=label, sched_stats=sstats)
         self._record_stage(label, results, num_buckets, temps,
                            sched_stats=sstats)
         schema = results[0]["schema"] if results else None
@@ -1740,6 +2127,40 @@ class Engine:
             stats["bytes_shuffled"] = sum(int(r.get("shuffle_bytes", 0))
                                           for r in results)
         return self._gather_buckets(results, num_buckets, temps), schema
+
+    # ---- wide operators -----------------------------------------------------
+    def _shuffle_children(self, node: P.PlanNode, num_buckets: int,
+                          keys: Optional[List[str]], temps: List[ObjectRef],
+                          range_key=None, pre_steps: Optional[List[T.Step]] = None,
+                          label: str = "shuffle",
+                          stats: Optional[Dict[str, Any]] = None,
+                          aqe_capable: bool = True,
+                          ) -> Tuple[List[List[Any]], Optional[bytes]]:
+        """Execute ``node`` with SHUFFLE output; transpose map×bucket → bucket×map.
+
+        ``pre_steps`` run on each map task AFTER the narrow chain and BEFORE
+        bucketing (the hook map-side partial aggregation uses); ``label`` names
+        the stage in the engine's shuffle ledger. ``stats``, when given, is
+        filled with the stage's measured ``bytes_shuffled`` — the number the
+        AQE post-map broadcast rule re-plans on (AQE-capable stages never
+        stream, so the two never coexist). When the stage pipelines
+        (:meth:`_stream_ok`) the returned buckets are
+        :class:`_StreamBucket` placeholders, the map stage keeps running on
+        a background thread, and the schema comes back ``None`` — streamed
+        reads decode it from the map blobs' IPC streams."""
+        tasks, preferred = self._compile(node, temps)
+        extra = list(pre_steps or [])
+        consolidate = _consolidate_enabled()
+        tasks = [t.with_output(steps=t.steps + extra,
+                               shuffle_pre_steps=len(extra),
+                               output=T.SHUFFLE, num_buckets=num_buckets,
+                               shuffle_keys=keys, range_key=range_key,
+                               shuffle_consolidate=consolidate,
+                               owner=self.owner)
+                 for t in tasks]
+        return self._dispatch_shuffle_stage(tasks, preferred, num_buckets,
+                                            label, temps, aqe_capable,
+                                            consolidate, stats=stats)
 
     def _aqe_split_partial_agg(self, buckets: List[List[Any]],
                                schema: Optional[bytes], keys: List[str],
@@ -1967,7 +2388,16 @@ class Engine:
             if node.how in T.BROADCAST_RIGHT_JOIN_TYPES else None
         tasks, pref_parts = [], []
         for b, (lb, rb) in enumerate(zip(left_buckets, right_buckets)):
-            if any(isinstance(x, tuple) for x in rb):
+            stream_rb = next((x for x in rb if isinstance(x, _StreamBucket)),
+                             None)
+            if stream_rb is not None:
+                # pipelined right side: the build table accumulates from
+                # seal notifications while BOTH map stages still run
+                join_step = T.HashJoinStep([], node.keys, node.right_keys,
+                                           node.how, right_schema=rschema,
+                                           right_stream=stream_rb.source(
+                                               rschema))
+            elif any(isinstance(x, tuple) for x in rb):
                 join_step = T.HashJoinStep([], node.keys, node.right_keys,
                                            node.how, right_schema=rschema,
                                            right_parts=self._as_parts(rb))
@@ -2041,21 +2471,19 @@ class Engine:
                     if not boundaries or tup != boundaries[-1]:
                         boundaries.append(tup)
 
+        consolidate = _consolidate_enabled()
         shuffle_tasks = [
             self._task(T.ArrowRefSource([ref], schema=schema)).with_output(
                 output=T.SHUFFLE, num_buckets=len(boundaries) + 1,
                 range_key=(list(keys), boundaries),
-                shuffle_consolidate=_consolidate_enabled(),
+                shuffle_consolidate=consolidate,
                 owner=self.owner)
             for ref in refs
         ]
-        sstats: Dict[str, Any] = {}
-        results = self._run_stage(shuffle_tasks, None, temps,
-                                  lineage_label="sort-range",
-                                  sched_stats=sstats)
-        self._record_stage("sort-range", results, len(boundaries) + 1, temps,
-                           sched_stats=sstats)
-        buckets = self._gather_buckets(results, len(boundaries) + 1, temps)
+        # sort-range is never AQE-re-planned: it pipelines under AQE too
+        buckets, _ = self._dispatch_shuffle_stage(
+            shuffle_tasks, None, len(boundaries) + 1, "sort-range", temps,
+            aqe_capable=False, consolidate=consolidate)
         # buckets come out in global sort order for any direction mix (the
         # composite comparison honors per-key direction; nulls sort last)
         tasks = [self._bucket_task(bucket, schema,
@@ -2106,9 +2534,10 @@ class Engine:
 
         if node.partition_keys:
             nb = self._num_buckets()
+            # window is never AQE-re-planned: it pipelines under AQE too
             buckets, schema = self._shuffle_children(
                 child, nb, keys=list(node.partition_keys), temps=temps,
-                label="window")
+                label="window", aqe_capable=False)
             tasks = [self._bucket_task(bucket, schema, list(steps), "window")
                      for bucket in buckets]
             return tasks, self._locality(buckets)

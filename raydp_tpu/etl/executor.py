@@ -191,14 +191,44 @@ class EtlExecutor:
         return self.executor_id
 
     # -- compute ---------------------------------------------------------------
-    def run_task(self, task_bytes: bytes) -> Dict[str, Any]:
-        """Execute one task; the return shape depends on the task's output mode."""
-        from raydp_tpu import profiler
+    def run_task(self, task_bytes: bytes):
+        """Execute one task; the return shape depends on the task's output
+        mode. Tasks with a STREAMING source (pipelined-shuffle reducers, and
+        downstream map tasks reading a pipelined stage) run on a dedicated
+        daemon thread behind a :class:`~raydp_tpu.runtime.rpc.DeferredReply`:
+        they spend most of their life waiting on seal notifications and
+        eagerly fetching/decoding arriving portions, and parking one of the
+        bounded RPC dispatcher threads on that wait could starve — or, with
+        every dispatcher parked, deadlock — the very map tasks being waited
+        on. One thread per streaming task (no pool, so no queue to deadlock
+        in); the count is bounded by the driver's per-executor in-flight
+        caps."""
+        from concurrent.futures import Future
+
+        from raydp_tpu.runtime.rpc import DeferredReply
 
         task: T.Task = cloudpickle.loads(task_bytes)
+        if T.stream_sources_of(task):
+            fut: Future = Future()
+
+            def _run():
+                try:
+                    fut.set_result(self._run_task_obj(task))
+                except BaseException as e:  # noqa: BLE001 - serialize any
+                    fut.set_exception(e)
+
+            threading.Thread(target=_run, daemon=True,
+                             name=f"rdt-stream-{task.task_id}").start()
+            return DeferredReply(fut)
+        return self._run_task_obj(task)
+
+    def _run_task_obj(self, task: T.Task) -> Dict[str, Any]:
+        from raydp_tpu import profiler
+
         # the fault key carries the executor name so a chaos schedule can
         # target ONE executor (`match=<executor name>|` = a seeded straggler
-        # or crashy node) as well as one task (`match=<task id>`)
+        # or crashy node) as well as one task (`match=<task id>`; shuffle map
+        # tasks carry an `mt-` id prefix, so `match=|mt-` pins the map side)
         rule = faults.check("executor.run_task",
                             key=f"{self._actor_name}|{task.task_id}")
         if rule is not None:
@@ -215,6 +245,9 @@ class EtlExecutor:
             rpc1 = client.rpc_counters()
             result["meta_rpcs"] = rpc1["meta"] - rpc0["meta"]
             result["fetch_rpcs"] = rpc1["fetch"] - rpc0["fetch"]
+            # streamed reads leave overlap/first-fetch stats on their
+            # sources; the driver folds them into the CONSUMED stage's entry
+            result.update(T.collect_stream_stats(task))
             return result
 
         pre = (int(getattr(task, "shuffle_pre_steps", 0) or 0)

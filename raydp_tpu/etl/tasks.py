@@ -14,6 +14,7 @@ Everything here must stay picklable and runnable inside an executor actor proces
 from __future__ import annotations
 
 import io
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -25,8 +26,8 @@ import pyarrow.parquet as pq
 
 from raydp_tpu import faults
 from raydp_tpu.etl.expressions import Expr, evaluate_to_array
-from raydp_tpu.runtime.object_store import ObjectLostError, ObjectRef, \
-    get_client
+from raydp_tpu.runtime.object_store import KIND_RAW, ObjectLostError, \
+    ObjectRef, ShuffleStreamAborted, get_client
 
 # -- output modes -------------------------------------------------------------------
 RETURN_REF = "return_ref"
@@ -113,6 +114,29 @@ class ParquetSource(Step):
         return f.read_row_groups(self.row_groups, columns=self.columns)
 
 
+def _ranged_fetch_fault(client, parts: List[Tuple["ObjectRef", int, int]],
+                        total: int) -> None:
+    """The ``shuffle.fetch`` fault site, shared by every ranged reader
+    (barrier :class:`RangeRefSource` and streamed
+    :class:`StreamingRangeSource` — the chaos matrix compares the two
+    directly, so the drop/delay semantics must never diverge): ``drop``
+    frees part ``bucket=N``'s backing blob and surfaces the typed loss (the
+    store-host-died model); generic actions honor ``ms_per_mb=`` against
+    the bytes this read moves."""
+    rule = faults.check("shuffle.fetch",
+                        key=parts[0][0].id if parts else "")
+    if rule is None:
+        return
+    if rule.action == "drop" and parts:
+        victim = parts[rule.bucket % len(parts)][0]
+        try:
+            client.free([victim])
+        except Exception:
+            pass
+        raise ObjectLostError(victim.id, "fault-injected fetch drop")
+    faults.apply(rule, "shuffle.fetch", nbytes=total)
+
+
 def concat_or_empty(tables: List[pa.Table],
                     schema: Optional[bytes]) -> pa.Table:
     """Concat bucket/block tables; an empty input list falls back to the
@@ -161,30 +185,135 @@ class RangeRefSource(Step):
 
         client = get_client()
         total = sum(size for _, _, size in self.parts)
-        # the ranged-read fault site: ``drop`` removes one part's backing
-        # blob and surfaces the typed loss (the store-host-died model for
-        # consolidated reduce reads, skew-split portions, and broadcast
-        # replicas — all of which must route into lineage recovery); the
-        # generic ``delay`` additionally honors ``ms_per_mb=`` so a chaos
-        # schedule can model a slow data plane whose cost scales with the
-        # bytes a task actually fetches
-        rule = faults.check("shuffle.fetch",
-                            key=self.parts[0][0].id if self.parts else "")
-        if rule is not None:
-            if rule.action == "drop" and self.parts:
-                victim = self.parts[rule.bucket % len(self.parts)][0]
-                try:
-                    client.free([victim])
-                except Exception:
-                    pass
-                raise ObjectLostError(victim.id, "fault-injected fetch drop")
-            faults.apply(rule, "shuffle.fetch", nbytes=total)
+        # the ranged-read fault site (shared with the streamed reader):
+        # ``drop`` removes one part's backing blob and surfaces the typed
+        # loss — the store-host-died model for consolidated reduce reads,
+        # skew-split portions, and broadcast replicas, all of which must
+        # route into lineage recovery
+        _ranged_fetch_fault(client, self.parts, total)
         with profiler.trace("shuffle:fetch", "etl", parts=len(self.parts),
                             bytes=total):
             bufs = client.get_range_buffers(self.parts)
         tables = [pa.ipc.open_stream(pa.py_buffer(b)).read_all()
                   for b in bufs]
         return concat_or_empty(tables, self.schema)
+
+
+@dataclass
+class StreamingRangeSource(Step):
+    """The pipelined-shuffle reduce reader: consumes seal notifications from
+    the store server's per-stage stream ledger and accumulates partial
+    fetches — each map task's portion of this bucket is fetched + decoded as
+    soon as that map SEALS, overlapping reduce-side work with the map tail
+    instead of waiting for the stage barrier (doc/etl.md "Pipelined
+    shuffle"). Decoded portions concatenate in ``map_id`` order, so the
+    bucket's row order is identical to the barrier-mode
+    :class:`RangeRefSource` read of the same stage.
+
+    Generations: a lineage-regenerated producer re-seals under the same
+    ``map_id`` with ``gen+1`` and a fresh ``(ref, off, size)``. A portion
+    already decoded from the older generation is kept — reruns are
+    byte-identical — but a fetch failing :class:`ObjectLostError` on a stale
+    range first re-checks the ledger for a newer generation (another reducer
+    may have triggered recovery already) and refetches in place; with no
+    newer generation the loss rides the existing lineage-recovery path (the
+    task fails typed, the engine regenerates + re-seals, and the resubmitted
+    task reads the fresh generation).
+
+    An aborted/closed stream raises :class:`ShuffleStreamAborted` (no-retry:
+    replaying the consumer replays the abort), carrying the map stage's
+    error when there was one.
+
+    After ``load`` the instance carries ``stream_stats``:
+    ``overlap_s`` (seconds spent fetching/decoding before the final seal
+    notification arrived — the measured map/reduce overlap),
+    ``first_fetch_ts`` (wall-clock of the first fetch), and ``rounds``."""
+
+    stage_key: str
+    bucket: int
+    num_maps: int
+    schema: Optional[bytes] = None
+    poll_timeout_s: float = 10.0
+
+    def load(self) -> pa.Table:
+        from raydp_tpu import profiler
+
+        client = get_client()
+        tables: Dict[int, pa.Table] = {}
+        gens: Dict[int, int] = {}
+        stats = {"overlap_s": 0.0, "first_fetch_ts": None, "rounds": 0}
+        self.stream_stats = stats
+        while len(tables) < self.num_maps:
+            resp = client.stream_poll(self.stage_key, self.bucket, gens,
+                                      self.poll_timeout_s)
+            if resp.get("aborted"):
+                raise ShuffleStreamAborted(
+                    f"shuffle stream {self.stage_key} aborted: "
+                    f"{resp['aborted']}")
+            parts, metas = [], []
+            for map_id, gen, ref_id, blob_size, off, size in \
+                    resp.get("events") or []:
+                if gens.get(map_id, 0) >= gen:
+                    continue
+                if map_id in tables:
+                    # a re-sealed generation of a portion we already hold:
+                    # reruns are byte-identical, so keep ours — just adopt
+                    # the generation (or the superseded event would come
+                    # back on every poll)
+                    gens[map_id] = int(gen)
+                    continue
+                parts.append((ObjectRef(id=ref_id, size=blob_size,
+                                        kind=KIND_RAW), int(off), int(size)))
+                metas.append((int(map_id), int(gen)))
+            if not parts:
+                continue
+            total = sum(size for _, _, size in parts)
+            # does this batch complete the stage? If not, the map tail is
+            # still running and the fetch+decode below is measured OVERLAP
+            tail_live = len(set(tables) | {m for m, _ in metas}) \
+                < self.num_maps
+            t0 = time.perf_counter()
+            if stats["first_fetch_ts"] is None:
+                stats["first_fetch_ts"] = time.time()
+            # the fault site sits INSIDE the timed window: an injected
+            # per-MiB delay models fetch cost, so it must count as overlap
+            _ranged_fetch_fault(client, parts, total)
+            try:
+                with profiler.trace("shuffle:fetch", "etl",
+                                    parts=len(parts), bytes=total,
+                                    streamed=True):
+                    bufs = client.get_range_buffers(parts)
+            except ObjectLostError as e:
+                # stale range: a regenerated producer may ALREADY have
+                # re-sealed a newer generation — discard this batch (gens
+                # uncommitted, so every portion reappears in the next poll)
+                # and refetch; no newer generation means the loss is fresh,
+                # so surface it into lineage recovery
+                probe = client.stream_poll(self.stage_key, self.bucket,
+                                           gens, timeout_s=0)
+                if probe.get("aborted"):
+                    # the map stage died and its sealed blobs were freed —
+                    # THAT is why the range is gone. Fail fast with the
+                    # abort's real cause instead of sending the typed loss
+                    # into a pointless lineage round against a dead stage
+                    raise ShuffleStreamAborted(
+                        f"shuffle stream {self.stage_key} aborted: "
+                        f"{probe['aborted']}") from e
+                newer = {m for m, g, *_ in probe.get("events") or []
+                         if g > dict(metas).get(m, g)}
+                if not newer:
+                    raise e
+                continue
+            for (map_id, gen), buf in zip(metas, bufs):
+                tables[map_id] = pa.ipc.open_stream(
+                    pa.py_buffer(buf)).read_all()
+                gens[map_id] = gen
+            dur = time.perf_counter() - t0
+            stats["rounds"] += 1
+            if tail_live:
+                stats["overlap_s"] += dur
+        return concat_or_empty([tables[i] for i in range(self.num_maps)],
+                               self.schema)
 
 
 @dataclass
@@ -710,7 +839,9 @@ class HashJoinStep(Step):
     """Join the incoming (left bucket) table against the right bucket refs.
 
     ``right_parts`` (byte-range triples) carries the right side when it was
-    shuffled through consolidated map outputs; otherwise ``right_refs``
+    shuffled through consolidated map outputs; ``right_stream`` when the
+    right map stage is PIPELINED (the build side accumulates from seal
+    notifications while both map stages still run); otherwise ``right_refs``
     holds whole-blob refs, exactly as before."""
 
     right_refs: List[ObjectRef]
@@ -719,9 +850,12 @@ class HashJoinStep(Step):
     how: str = "inner"
     right_schema: Optional[bytes] = None
     right_parts: Optional[List[Tuple[ObjectRef, int, int]]] = None
+    right_stream: Optional[StreamingRangeSource] = None
 
     def run(self, table: pa.Table) -> pa.Table:
-        if self.right_parts is not None:
+        if self.right_stream is not None:
+            right = self.right_stream.load()
+        elif self.right_parts is not None:
             right = RangeRefSource(self.right_parts,
                                    schema=self.right_schema).load()
         else:
@@ -820,6 +954,11 @@ class Task:
     # the shuffle-stage label this task READS (set on reduce tasks): its
     # store-RPC counters are attributed to that stage's ledger entry
     consumes_stage: Optional[str] = None
+    # the UNIQUE stream stage_key this task reads when that stage is
+    # PIPELINED — labels repeat within one action (a.join(b).join(c) runs
+    # "join-left" twice), so the driver's attribution/wait logic must key
+    # on this, never the label
+    consumes_stream: Optional[str] = None
 
     def with_output(self, **kw) -> "Task":
         d = self.__dict__.copy()
@@ -833,6 +972,82 @@ def run_task_body(task: Task) -> pa.Table:
     for step in task.steps:
         table = step.run(table)
     return table
+
+
+# ==== pipelined-shuffle helpers ====================================================
+def stream_sources_of(task: Task) -> List[StreamingRangeSource]:
+    """Every :class:`StreamingRangeSource` a task reads through — its source,
+    a join step's streamed build side, or a cached recipe's nested task. The
+    executor routes tasks with any of these onto dedicated stream threads
+    (they WAIT on seal notifications, and parking a bounded dispatcher
+    thread on that wait could deadlock the very map tasks being waited on)."""
+    out: List[StreamingRangeSource] = []
+
+    def _step(step: Step) -> None:
+        if isinstance(step, StreamingRangeSource):
+            out.append(step)
+        rs = getattr(step, "right_stream", None)
+        if isinstance(rs, StreamingRangeSource):
+            out.append(rs)
+        if isinstance(step, CachedSource) and step.recover is not None:
+            out.extend(stream_sources_of(step.recover))
+
+    _step(task.source)
+    for s in task.steps:
+        _step(s)
+    return out
+
+
+def collect_stream_stats(task: Task) -> Dict[str, float]:
+    """Fold the per-source ``stream_stats`` left behind by a streamed read
+    into the result keys the driver's stage ledger aggregates."""
+    srcs = [s for s in stream_sources_of(task)
+            if getattr(s, "stream_stats", None) is not None]
+    if not srcs:
+        return {}
+    out: Dict[str, float] = {
+        "stream_overlap_s": sum(s.stream_stats["overlap_s"] for s in srcs),
+        "stream_rounds": sum(s.stream_stats["rounds"] for s in srcs),
+    }
+    firsts = [s.stream_stats["first_fetch_ts"] for s in srcs
+              if s.stream_stats["first_fetch_ts"] is not None]
+    if firsts:
+        out["stream_first_fetch_ts"] = min(firsts)
+    return out
+
+
+def resolve_stream_sources(task: Task, resolver) -> Task:
+    """Rewrite a task's streaming reads into concrete
+    :class:`RangeRefSource` reads — ``resolver(stage_key, bucket)`` returns
+    the final ``(ref, off, size)`` parts once the stage's maps have ALL
+    sealed. Used before a task is serialized to OUTLIVE its action (cache()
+    recover recipes): the stream ledger closes with the action, so a recipe
+    kept in streaming form would be permanently unreadable."""
+    import dataclasses
+
+    def _res(step: Step) -> Step:
+        if isinstance(step, StreamingRangeSource):
+            return RangeRefSource(resolver(step.stage_key, step.bucket),
+                                  schema=step.schema)
+        if isinstance(step, HashJoinStep) \
+                and isinstance(step.right_stream, StreamingRangeSource):
+            rs = step.right_stream
+            return dataclasses.replace(
+                step, right_stream=None,
+                right_parts=resolver(rs.stage_key, rs.bucket),
+                right_schema=step.right_schema or rs.schema)
+        if isinstance(step, CachedSource) and step.recover is not None:
+            recover = resolve_stream_sources(step.recover, resolver)
+            if recover is not step.recover:
+                return dataclasses.replace(step, recover=recover)
+        return step
+
+    source = _res(task.source)
+    steps = [_res(s) for s in task.steps]
+    if source is task.source \
+            and all(a is b for a, b in zip(steps, task.steps)):
+        return task
+    return task.with_output(source=source, steps=steps)
 
 
 # ==== lineage-recovery ref surgery =================================================

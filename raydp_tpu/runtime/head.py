@@ -170,6 +170,24 @@ class HeadService:
     def store_locations(self, *a):
         return self._rt.store_server.locations(*a)
 
+    # pipelined-shuffle seal notifications: poll may return a DeferredReply
+    # (the head's RPC server resolves it when events arrive or the poll
+    # timeout lapses), so a long-polling reducer never parks a dispatcher
+    def store_stream_begin(self, *a):
+        return self._rt.store_server.stream_begin(*a)
+
+    def store_stream_publish(self, *a):
+        return self._rt.store_server.stream_publish(*a)
+
+    def store_stream_poll(self, *a):
+        return self._rt.store_server.stream_poll(*a)
+
+    def store_stream_abort(self, *a):
+        return self._rt.store_server.stream_abort(*a)
+
+    def store_stream_close(self, *a):
+        return self._rt.store_server.stream_close(*a)
+
     def register_store_host(self, node_id: str, arena_segment,
                             shm_budget=None):
         """A node agent announces its machine-local payload plane."""

@@ -32,9 +32,12 @@ The metadata entry records ``offset >= 0`` for arena-resident payloads.
 
 from __future__ import annotations
 
+import collections
 import os
 import secrets
 import threading
+import time as _time_mod
+from concurrent.futures import Future
 from dataclasses import dataclass, field
 from multiprocessing import resource_tracker, shared_memory
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -44,6 +47,7 @@ import pyarrow as pa
 
 from raydp_tpu import faults
 from raydp_tpu.log import get_logger
+from raydp_tpu.runtime.rpc import DeferredReply
 
 logger = get_logger("object_store")
 
@@ -69,6 +73,218 @@ class ObjectLostError(KeyError):
     # not KeyError.__str__: loss messages must not render repr-quoted in
     # logs, RemoteError.message, and ObjectsLostError text
     __str__ = Exception.__str__
+
+
+class ShuffleStreamAborted(RuntimeError):
+    """A pipelined-shuffle seal stream ended without completing: its map
+    stage failed (the driver published an abort) or the stage was closed /
+    never began (a drain-abandoned reducer polling after its action ended).
+    Deterministic from the reducer's point of view — retrying the consumer
+    replays the same abort — so the engine treats it as no-retry and the
+    stage fails fast with the abort's message (which carries the map-stage
+    error when there was one)."""
+
+
+class _StreamStage:
+    """Seal ledger of ONE pipelined shuffle stage: the latest generation of
+    every map task's consolidated blob (``map_id -> (gen, ref_id, blob_size,
+    bucket_index)``). A regenerated producer re-seals under the same map_id
+    with a higher generation; reducers holding the older generation's decoded
+    portion keep it (reruns are byte-identical), reducers whose fetch of the
+    stale range fails refetch the newer one."""
+
+    __slots__ = ("num_maps", "seals", "aborted")
+
+    def __init__(self, num_maps: Optional[int]):
+        self.num_maps = num_maps
+        self.seals: Dict[int, Tuple[int, str, int, list]] = {}
+        self.aborted: Optional[str] = None
+
+
+class ShuffleStreamLedger:
+    """Seal-notification plane of the pipelined shuffle (head-resident, next
+    to the object table): the driver publishes ``(map_id, ref, per-bucket
+    offset/size index)`` as each map task's consolidated blob seals — only
+    the WINNING attempt's result reaches the driver, so a speculation loser
+    never publishes — and already-dispatched reduce tasks long-poll for the
+    events of their bucket, beginning ranged fetch + Arrow decode while the
+    map tail is still running.
+
+    Long-polls do not park an RPC dispatcher thread: ``poll`` returns a
+    :class:`~raydp_tpu.runtime.rpc.DeferredReply` whose future completes on
+    the next publish/abort/close or when the poll timeout lapses (a lazy
+    sweeper thread that exits whenever no waiter is outstanding)."""
+
+    TOMBSTONES = 1024  # closed stage keys remembered so late polls abort
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._stages: Dict[str, _StreamStage] = {}
+        self._closed: "collections.OrderedDict[str, bool]" = \
+            collections.OrderedDict()
+        self._waiters: List[Dict[str, Any]] = []
+        self._sweeper: Optional[threading.Thread] = None
+        self._stopped = False
+
+    # -- driver side ----------------------------------------------------------
+    def begin(self, stage_key: str, num_maps: int) -> None:
+        with self._lock:
+            st = self._stages.get(stage_key)
+            if st is None:
+                self._stages[stage_key] = _StreamStage(int(num_maps))
+            else:
+                st.num_maps = int(num_maps)
+
+    def publish(self, stage_key: str, map_id: int, gen: int, ref_id: str,
+                size: int, index: Sequence[Sequence[int]]) -> None:
+        with self._lock:
+            st = self._stages.get(stage_key)
+            if st is None:
+                # a legitimate publish always follows stream_begin, so an
+                # unknown key is a late republish after the action closed
+                # (possibly past the tombstone window) — drop it rather
+                # than resurrect a stage no close() would ever remove
+                return
+            cur = st.seals.get(int(map_id))
+            if cur is None or int(gen) > cur[0]:
+                st.seals[int(map_id)] = (int(gen), ref_id, int(size),
+                                         [tuple(e) for e in index])
+            ready = self._collect_ready_locked(stage_key)
+        self._complete(ready)
+
+    def abort(self, stage_key: str, message: str) -> None:
+        with self._lock:
+            st = self._stages.get(stage_key)
+            if st is None:
+                return  # already closed (pollers abort via the tombstone
+                #         / unknown-key path) — never resurrect the stage
+            if st.aborted is None:
+                st.aborted = str(message)
+            ready = self._collect_ready_locked(stage_key)
+        self._complete(ready)
+
+    def close(self, stage_keys: Sequence[str]) -> None:
+        ready: List[Tuple[Future, Dict[str, Any]]] = []
+        with self._lock:
+            for key in stage_keys:
+                self._stages.pop(key, None)
+                self._closed[key] = True
+                while len(self._closed) > self.TOMBSTONES:
+                    self._closed.popitem(last=False)
+                ready.extend(self._collect_ready_locked(key))
+        self._complete(ready)
+
+    # -- reducer side ---------------------------------------------------------
+    def poll(self, stage_key: str, bucket: int,
+             have: Optional[Dict[int, int]], timeout_s: float):
+        """Events newer than ``have`` (``map_id -> generation``) for one
+        bucket, immediately when any exist (or the stage is aborted/closed),
+        else a DeferredReply completed by the next publish or the timeout."""
+        have = {int(k): int(v) for k, v in (have or {}).items()}
+        with self._lock:
+            resp = self._resp_locked(stage_key, int(bucket), have)
+            if resp is not None or timeout_s <= 0 or self._stopped:
+                return resp if resp is not None \
+                    else self._empty_locked(stage_key)
+            fut: Future = Future()
+            self._waiters.append({
+                "key": stage_key, "bucket": int(bucket), "have": have,
+                "fut": fut,
+                "deadline": _time_mod.monotonic() + float(timeout_s)})
+            self._ensure_sweeper_locked()
+            self._cond.notify_all()
+        return DeferredReply(fut)
+
+    # -- internals ------------------------------------------------------------
+    def _empty_locked(self, stage_key: str) -> Dict[str, Any]:
+        st = self._stages.get(stage_key)
+        return {"events": [], "aborted": None,
+                "expected": st.num_maps if st is not None else None}
+
+    def _resp_locked(self, stage_key: str, bucket: int,
+                     have: Dict[int, int]) -> Optional[Dict[str, Any]]:
+        st = self._stages.get(stage_key)
+        if st is None:
+            reason = "stream closed" if stage_key in self._closed \
+                else "unknown stream stage"
+            return {"events": [], "aborted": f"{reason}: {stage_key}",
+                    "expected": None}
+        events = []
+        for map_id, (gen, ref_id, size, index) in st.seals.items():
+            if gen <= have.get(map_id, 0):
+                continue
+            if bucket >= len(index):
+                raise ValueError(
+                    f"bucket {bucket} out of range for stage {stage_key} "
+                    f"(map {map_id} sealed {len(index)} buckets)")
+            off, bsize = int(index[bucket][0]), int(index[bucket][1])
+            events.append((map_id, gen, ref_id, size, off, bsize))
+        if events or st.aborted is not None:
+            return {"events": events, "aborted": st.aborted,
+                    "expected": st.num_maps}
+        return None
+
+    def _collect_ready_locked(self, stage_key: str
+                              ) -> List[Tuple[Future, Dict[str, Any]]]:
+        ready, keep = [], []
+        for w in self._waiters:
+            if w["key"] != stage_key:
+                keep.append(w)
+                continue
+            resp = self._resp_locked(stage_key, w["bucket"], w["have"])
+            if resp is not None:
+                ready.append((w["fut"], resp))
+            else:
+                keep.append(w)
+        self._waiters = keep
+        return ready
+
+    @staticmethod
+    def _complete(ready: List[Tuple[Future, Dict[str, Any]]]) -> None:
+        # futures complete OUTSIDE the ledger lock: a done-callback (the RPC
+        # server's reply submit) must never run under it
+        for fut, resp in ready:
+            if not fut.done():
+                fut.set_result(resp)
+
+    def _ensure_sweeper_locked(self) -> None:
+        if self._sweeper is None or not self._sweeper.is_alive():
+            self._sweeper = threading.Thread(
+                target=self._sweep_loop, daemon=True,
+                name="rdt-stream-ledger-sweep")
+            self._sweeper.start()
+
+    def _sweep_loop(self) -> None:
+        while True:
+            with self._lock:
+                if not self._waiters:
+                    self._sweeper = None
+                    return
+                now = _time_mod.monotonic()
+                due = [w for w in self._waiters
+                       if w["deadline"] <= now or self._stopped]
+                if due:
+                    self._waiters = [w for w in self._waiters
+                                     if w not in due]
+                    ready = [(w["fut"], self._empty_locked(w["key"]))
+                             for w in due]
+                else:
+                    nxt = min(w["deadline"] for w in self._waiters)
+                    self._cond.wait(timeout=max(0.01, min(nxt - now, 5.0)))
+                    continue
+            self._complete(ready)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._stopped = True
+            waiters, self._waiters = self._waiters, []
+            self._stages.clear()
+            self._cond.notify_all()
+        self._complete([(w["fut"], {"events": [], "expected": None,
+                                    "aborted": "store shutting down"})
+                        for w in waiters])
+
 
 KIND_RAW = "raw"
 KIND_PICKLE = "pickle"
@@ -307,6 +523,9 @@ class ObjectStoreServer:
         self._spill_locks: Dict[str, threading.Lock] = {}
         self._fault_gen = 0        # fault-in segments get fresh names (the
         #                            old name may still be alive under grace)
+        # pipelined-shuffle seal notifications (doc/etl.md "Pipelined
+        # shuffle"): the metadata-plane extension reducers long-poll
+        self._streams = ShuffleStreamLedger()
 
     # -- control-plane accounting ---------------------------------------------
     def _count_op(self, name: str) -> None:
@@ -656,6 +875,40 @@ class ObjectStoreServer:
             return {oid: self._table[oid].host_id for oid in object_ids
                     if oid in self._table}
 
+    # -- pipelined-shuffle seal notifications ----------------------------------
+    def stream_begin(self, stage_key: str, num_maps: int) -> None:
+        """Open a seal stream for one shuffle stage (driver, before any
+        reduce task dispatches — a poll on a never-begun stage aborts)."""
+        self._count_op("stream_begin")
+        self._streams.begin(stage_key, num_maps)
+
+    def stream_publish(self, stage_key: str, map_id: int, gen: int,
+                       ref_id: str, size: int,
+                       index: Sequence[Sequence[int]]) -> None:
+        """Seal notification: map ``map_id``'s consolidated blob (generation
+        ``gen`` — a lineage-regenerated producer re-seals with gen+1) with
+        its per-bucket (offset, size, rows) index."""
+        self._count_op("stream_publish")
+        self._streams.publish(stage_key, map_id, gen, ref_id, size, index)
+
+    def stream_poll(self, stage_key: str, bucket: int,
+                    have: Optional[Dict[int, int]] = None,
+                    timeout_s: float = 10.0):
+        """Long-poll one bucket's seal events newer than ``have``; may return
+        a DeferredReply (completed on publish/abort/close or timeout)."""
+        self._count_op("stream_poll")
+        return self._streams.poll(stage_key, bucket, have, timeout_s)
+
+    def stream_abort(self, stage_key: str, message: str) -> None:
+        self._count_op("stream_abort")
+        self._streams.abort(stage_key, message)
+
+    def stream_close(self, stage_keys: List[str]) -> None:
+        """Action end: drop the stage ledgers; drain-abandoned reducers still
+        polling get an abort instead of waiting forever."""
+        self._count_op("stream_close")
+        self._streams.close(stage_keys)
+
     def fetch_ranges(self, items: List[Sequence]) -> List[bytes]:
         """Byte ranges of payloads hosted on the HEAD machine, one RPC for
         many ranges: each item is ``(segment, base, start, size)`` — the
@@ -784,6 +1037,7 @@ class ObjectStoreServer:
             return [o for o, e in self._table.items() if e.owner == owner]
 
     def shutdown(self) -> None:
+        self._streams.shutdown()
         with self._lock:
             entries = list(self._table.items())
             self._table.clear()
@@ -879,9 +1133,12 @@ class ObjectStoreClient:
         self._retired: List[shared_memory.SharedMemory] = []
         # control-plane instrumentation: table-server calls and payload-fetch
         # RPCs issued by THIS process (executors report per-task deltas into
-        # the engine's shuffle ledger)
+        # the engine's shuffle ledger). Seal-stream polls are counted apart —
+        # a long-poll is a wait, not a table op, and folding it into
+        # meta_rpcs would make the consolidation comparisons meaningless.
         self.meta_rpc_count = 0
         self.fetch_rpc_count = 0
+        self.stream_poll_count = 0
         self._lock = threading.Lock()
         self._arena = None          # native write handle, lazily probed
         self._arena_probed = False
@@ -1456,6 +1713,36 @@ class ObjectStoreClient:
                     f.result()
         return out  # type: ignore[return-value]
 
+    # -- pipelined-shuffle seal notifications ----------------------------------
+    def stream_begin(self, stage_key: str, num_maps: int) -> None:
+        self._server.stream_begin(stage_key, int(num_maps))
+
+    def stream_publish(self, stage_key: str, map_id: int, gen: int,
+                       ref_id: str, size: int,
+                       index: Sequence[Sequence[int]]) -> None:
+        self._server.stream_publish(stage_key, int(map_id), int(gen),
+                                    ref_id, int(size), list(index))
+
+    def stream_poll(self, stage_key: str, bucket: int,
+                    have: Optional[Dict[int, int]] = None,
+                    timeout_s: float = 10.0) -> Dict[str, Any]:
+        """One seal-stream poll round. In-process (driver) callers get the
+        server's DeferredReply and wait its future here; proxied callers
+        (executors) receive the final dict — the head's RPC server resolves
+        the deferred reply before the response frame ships."""
+        self.stream_poll_count += 1
+        res = self._server.stream_poll(stage_key, int(bucket),
+                                       dict(have or {}), float(timeout_s))
+        if isinstance(res, DeferredReply):
+            res = res.future.result()
+        return res
+
+    def stream_abort(self, stage_key: str, message: str) -> None:
+        self._server.stream_abort(stage_key, str(message))
+
+    def stream_close(self, stage_keys: Sequence[str]) -> None:
+        self._server.stream_close(list(stage_keys))
+
     # -- lifetime -------------------------------------------------------------
     def free(self, refs: List[ObjectRef]) -> int:
         """Release blobs; idempotent and duplicate-tolerant — a speculation
@@ -1489,9 +1776,12 @@ class ObjectStoreClient:
         return self._server.stats()
 
     def rpc_counters(self) -> Dict[str, int]:
-        """Control-plane calls this process issued: ``meta`` (table server)
-        and ``fetch`` (payload-fetch RPCs; zero on the pure local-shm path)."""
-        return {"meta": self.meta_rpc_count, "fetch": self.fetch_rpc_count}
+        """Control-plane calls this process issued: ``meta`` (table server),
+        ``fetch`` (payload-fetch RPCs; zero on the pure local-shm path), and
+        ``stream_poll`` (pipelined-shuffle seal polls — long waits, counted
+        apart so they never pollute the metadata-plane comparisons)."""
+        return {"meta": self.meta_rpc_count, "fetch": self.fetch_rpc_count,
+                "stream_poll": self.stream_poll_count}
 
     def close(self) -> None:
         self._sweep_retired()

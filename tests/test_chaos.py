@@ -612,6 +612,109 @@ def test_shuffle_write_raise_after_put_leaves_no_orphans(tmp_path,
         raydp_tpu.stop()
 
 
+# ==== pipelined shuffle under chaos (ISSUE 8) ======================================
+def _run_groupagg_pipelined(app, pipeline="1"):
+    """The canonical groupagg with AQE pinned off so the pipelined mode
+    actually engages (the AQE-wins rule barriers AQE-capable stages);
+    ``pipeline="0"`` is the fault-free BARRIER baseline the pipelined chaos
+    legs compare byte-identical against."""
+    os.environ["RDT_ETL_AQE"] = "0"
+    os.environ["RDT_SHUFFLE_PIPELINE"] = pipeline
+    try:
+        return _run_groupagg(app)
+    finally:
+        os.environ.pop("RDT_ETL_AQE", None)
+        os.environ.pop("RDT_SHUFFLE_PIPELINE", None)
+
+
+def test_pipelined_stale_range_regenerates_and_reseals(tmp_path,
+                                                       monkeypatch):
+    """Chaos leg (a): a map blob dropped AFTER its seal notification but
+    BEFORE the reducer's fetch — ``shuffle.write:drop`` frees the
+    consolidated blob executor-side, yet the winning result still reaches
+    the driver, which publishes the seal; the streaming reducer's fetch of
+    the now-stale range hits ObjectLostError, rides the existing lineage
+    path (regenerate producer → RE-SEAL under the same map_id, next
+    generation → resubmit), and the result is byte-identical to a
+    fault-free BARRIER run."""
+    base, base_n, _ = _run_groupagg_pipelined("chaos-pipe-base",
+                                              pipeline="0")
+
+    sent = str(tmp_path / "pipe-drop.sentinel")
+    monkeypatch.setenv("RDT_FAULTS", f"shuffle.write:drop:nth=2:once={sent}")
+    got, got_n, report = _run_groupagg_pipelined("chaos-pipe-drop")
+    assert os.path.exists(sent), "injected drop never fired"
+    assert got_n == base_n
+    assert got == base
+    assert any(e["pipelined"] for e in report), report
+    assert sum(e.get("recovered", 0) for e in report) >= 1, report
+    assert sum(e.get("regenerated", 0) for e in report) >= 1, report
+
+
+def test_pipelined_speculation_losers_never_seal(tmp_path, monkeypatch):
+    """Chaos leg (b): speculation loser seals racing the winner. A seeded
+    one-executor straggler forces backup map tasks; only the FIRST
+    finisher's result reaches the driver, so only the winner's blob is ever
+    published to the seal stream — no duplicate bucket rows — and the
+    losers' blobs free through the late-result path (store count back to
+    the pre-action baseline)."""
+    from raydp_tpu.runtime.object_store import get_client
+
+    base, _, _ = _run_groupagg_pipelined("chaos-pipe-spec-base",
+                                         pipeline="0")
+
+    app = "chaos-pipe-spec"
+    victim = f"rdt-executor-{app}-0"
+    monkeypatch.setenv("RDT_FAULTS",
+                       f"executor.run_task:delay:ms=600:match={victim}|")
+    monkeypatch.setenv("RDT_SPECULATION_QUANTILE", "0.25")
+    monkeypatch.setenv("RDT_SPECULATION_MIN_S", "0.15")
+    monkeypatch.setenv("RDT_ETL_AQE", "0")
+    monkeypatch.setenv("RDT_SHUFFLE_PIPELINE", "1")
+    s = _session(app)
+    try:
+        client = get_client()
+        df = _frame(s)
+        before = client.stats()["num_objects"]
+        out = df.groupBy("k").agg(F.sum("v").alias("s"),
+                                  F.count("v").alias("n"))
+        table = s.engine.collect(out._plan).sort_by([("k", "ascending")])
+        report = s.engine.shuffle_stage_report()
+        assert _ipc_bytes(table) == base, \
+            "a loser's seal leaked duplicate bucket rows"
+        assert any(e["pipelined"] for e in report), report
+        assert sum(e.get("speculated", 0) for e in report) >= 1, report
+        deadline = time.time() + 30
+        while time.time() < deadline \
+                and client.stats()["num_objects"] != before:
+            time.sleep(0.2)
+        after = client.stats()["num_objects"]
+        assert after == before, (
+            f"pipelined speculation races orphaned {after - before} blobs")
+    finally:
+        raydp_tpu.stop()
+
+
+def test_pipelined_streamed_fetch_drop_recovery(tmp_path, monkeypatch):
+    """Chaos leg (c): pipelining + ``shuffle.fetch:drop`` — the drop fires
+    INSIDE a streaming reducer's fetch round (frees the backing blob, then
+    the typed loss), mid-stream with other portions already decoded; the
+    regenerated producer re-seals and the resubmitted reducer re-reads the
+    whole bucket byte-identical to a fault-free barrier run."""
+    base, base_n, _ = _run_groupagg_pipelined("chaos-pipe-fdrop-base",
+                                              pipeline="0")
+
+    sent = str(tmp_path / "pipe-fdrop.sentinel")
+    monkeypatch.setenv("RDT_FAULTS",
+                       f"shuffle.fetch:drop:nth=2:once={sent}")
+    got, got_n, report = _run_groupagg_pipelined("chaos-pipe-fdrop")
+    assert os.path.exists(sent), "injected streamed-fetch drop never fired"
+    assert got_n == base_n
+    assert got == base
+    assert any(e["pipelined"] for e in report), report
+    assert sum(e.get("recovered", 0) for e in report) >= 1, report
+
+
 # ==== adaptive execution under chaos (ISSUE 7) =====================================
 def _run_broadcast_join(app):
     """One session running the canonical broadcast join (small dim side →

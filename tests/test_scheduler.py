@@ -331,3 +331,47 @@ def test_locality_weights_total_range_bytes_across_all_parts(monkeypatch):
 
     monkeypatch.setattr(E, "get_client", lambda: _Client2())
     assert engine._locality([[(ra, 0, 10), (huge_b, 0, 4)]]) == ["eA"]
+
+
+def test_locality_reweights_streaming_reducers_from_seals_so_far(
+        monkeypatch):
+    """ISSUE 8 small fix: a streaming reduce task dispatched before the map
+    stage finishes used to be preference-free — its bucket has no concrete
+    ranges yet. ``_locality`` now expands a ``_StreamBucket`` to the ranges
+    of the seals seen SO FAR (the driver published them, so it knows), and
+    a stage with no seals yet genuinely has no preference."""
+    pool = ExecutorPool([StubExecutor(name="eA"), StubExecutor(name="eB")],
+                        hosts_by_name={"eA": "hostA", "eB": "hostB"})
+    engine = E.Engine(pool)
+
+    ra = ObjectRef(id="a" * 32, size=5000)
+    rb = ObjectRef(id="b" * 32, size=50)
+
+    class _Client:
+        def locations(self, refs):
+            return {("a" * 32): "hostA", ("b" * 32): "hostB"}
+
+    monkeypatch.setattr(E, "get_client", lambda: _Client())
+
+    rec = E._StreamStageRec("ss-test", "repartition", num_maps=3)
+    # no seals yet: genuinely preference-free
+    empty = E._StreamBucket(rec, 0)
+    assert engine._locality([[empty]]) == [None]
+    # two of three maps sealed; bucket 0's bytes live mostly on hostA,
+    # bucket 1's on hostB — each streaming reducer routes by ITS ranges
+    rec.seals[0] = (ra, [(0, 4000, 10), (4000, 10, 1)])
+    rec.seals[2] = (rb, [(0, 10, 1), (10, 40, 2)])
+    assert engine._locality([[E._StreamBucket(rec, 0)],
+                             [E._StreamBucket(rec, 1)]]) == ["eA", "eB"]
+    # a join-style entry mixing a stream bucket with concrete right-side
+    # ranges weighs them together
+    big_b = ObjectRef(id="c" * 32, size=9000)
+
+    class _Client2(_Client):
+        def locations(self, refs):
+            return {("a" * 32): "hostA", ("b" * 32): "hostB",
+                    ("c" * 32): "hostB"}
+
+    monkeypatch.setattr(E, "get_client", lambda: _Client2())
+    assert engine._locality(
+        [[E._StreamBucket(rec, 0), (big_b, 0, 9000)]]) == ["eB"]

@@ -76,6 +76,41 @@ def test_shuffle_bench_aqe_smoke(tmp_path):
     assert co["dispatch_reduction_x"] >= 4.0, co
 
 
+def test_shuffle_bench_pipeline_smoke(tmp_path):
+    """The --pipeline leg (benchmarks/PIPELINE.json harness): barrier vs
+    pipelined shuffle under the seeded per-map delay spread + per-MiB fetch
+    delay. Tier-1-safe floors: overlap must actually be OBSERVED (reducers
+    fetched while the map tail ran — the whole mechanism), results
+    row-identical, and the no-orphan audit holds with reducers mid-stream;
+    the wall speedup is asserted loosely (1-core CI host) — the recorded
+    full-size artifact carries the headline number."""
+    out_path = tmp_path / "PIPELINE_SMOKE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               RDT_PIPELINE_PATH=str(out_path))
+    for k in ("RDT_FAULTS", "RDT_SPECULATION", "RDT_SHUFFLE_PIPELINE",
+              "RDT_ETL_AQE", "RDT_SHUFFLE_CONSOLIDATE"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks", "shuffle_bench.py"),
+         "--pipeline", "--smoke"],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    record = json.loads(out_path.read_text())
+    assert record["metric"] == "etl_shuffle_pipeline" and record["smoke"]
+    cfg = record["configs"]["pipeline"]
+    assert cfg["identical"], "pipelining changed the shuffle's rows"
+    assert cfg["pipelined_pipelined"] and not cfg["pipelined_barrier"], cfg
+    assert cfg["overlap_s"] > 0, (
+        "no reduce-side fetch overlapped the map tail")
+    assert cfg["overlap_barrier_s"] == 0.0, cfg
+    assert cfg["first_reduce_fetch_s"] is not None \
+        and cfg["first_reduce_fetch_s"] < cfg["wall_pipelined_s"], cfg
+    assert cfg["orphans_pipelined"] == 0, (
+        f"mid-stream reducers orphaned {cfg['orphans_pipelined']} objects")
+    assert cfg["orphans_barrier"] == 0, cfg
+    assert cfg["speedup_x"] >= 1.1, cfg
+
+
 def test_shuffle_bench_straggler_smoke(tmp_path):
     """The --straggler leg (benchmarks/STRAGGLER.json harness): a seeded
     one-executor delay, speculation off vs on. At smoke scale the structural
