@@ -139,9 +139,10 @@ def _submit_overrides() -> Dict:
     bin/raydp-submit into the session). Explicit ``init`` arguments win;
     submitted values fill anything the script left at its default."""
     import json
-    import os
 
-    raw = os.environ.get("RDT_SUBMIT_ARGS")
+    from raydp_tpu import knobs
+
+    raw = knobs.get_raw("RDT_SUBMIT_ARGS")
     if not raw:
         return {}
     try:

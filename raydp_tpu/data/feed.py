@@ -26,7 +26,6 @@ of SURVEY.md §2.5's "TPU-native equivalent".
 
 from __future__ import annotations
 
-import os
 import queue
 import threading
 import time
@@ -36,6 +35,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 import pyarrow as pa
 
+from raydp_tpu import knobs
 from raydp_tpu.log import get_logger
 
 logger = get_logger("data.feed")
@@ -125,8 +125,7 @@ class HostBatchIterator:
         # per-iterator budget (train and eval feeds each get their own); env
         # read at construction so callers can tune it after import
         self.cache_cap_bytes = cache_cap_bytes if cache_cap_bytes is not None \
-            else int(float(os.environ.get("RDT_FEED_CACHE_MB", "2048"))
-                     * (1 << 20))
+            else int(float(knobs.get("RDT_FEED_CACHE_MB")) * (1 << 20))
         self._decoded: Dict[int, Dict[str, np.ndarray]] = {}
         self._cache_bytes = 0
         self._sizes: Optional[List[int]] = None
@@ -301,8 +300,8 @@ class GangShardIterator:
         # of every epoch — the dominant per-epoch host cost of a gang rank
         self._decoded: Dict[int, Dict[str, np.ndarray]] = {}
         self._cache_bytes = 0
-        self._cache_cap = int(float(os.environ.get(
-            "RDT_FEED_CACHE_MB", "2048")) * (1 << 20))
+        self._cache_cap = int(float(knobs.get("RDT_FEED_CACHE_MB"))
+                              * (1 << 20))
 
     def __len__(self) -> int:
         return self.total // self.global_batch
@@ -471,8 +470,7 @@ class DeviceEpochCache:
 
     @staticmethod
     def cap_bytes() -> int:
-        return int(float(os.environ.get("RDT_DEVICE_CACHE_MB", "2048"))
-                   * (1 << 20))
+        return int(float(knobs.get("RDT_DEVICE_CACHE_MB")) * (1 << 20))
 
     @staticmethod
     def estimate_bytes(dataset,
@@ -495,7 +493,7 @@ class DeviceEpochCache:
         within the HBM budget."""
         import jax
 
-        if os.environ.get("RDT_DEVICE_CACHE", "1") == "0":
+        if not knobs.get("RDT_DEVICE_CACHE"):
             return False
         if not drop_last or jax.process_count() > 1:
             return False
@@ -709,8 +707,7 @@ class DeviceFeed:
             seed=seed, drop_remainder=drop_remainder)
         self.prefetch = max(1, prefetch)
         if prefetch_to_device is None:
-            prefetch_to_device = int(
-                os.environ.get("RDT_PREFETCH_TO_DEVICE", "2"))
+            prefetch_to_device = int(knobs.get("RDT_PREFETCH_TO_DEVICE"))
         #: already-placed batches kept ahead of the consumer (0 = place
         #: synchronously on the consumer thread)
         self.prefetch_to_device = max(0, int(prefetch_to_device))

@@ -27,7 +27,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
-from raydp_tpu import profiler
+from raydp_tpu import knobs, profiler
 from raydp_tpu.etl import optimizer as O
 from raydp_tpu.etl import plan as P
 from raydp_tpu.etl import tasks as T
@@ -109,8 +109,7 @@ def _consolidate_enabled() -> bool:
     """Consolidated-map-output kill switch; read per action (driver side)
     and carried on each task, so a mid-session toggle never mixes formats
     within one stage. Same pattern as ``RDT_ETL_OPTIMIZER``."""
-    v = os.environ.get("RDT_SHUFFLE_CONSOLIDATE", "1").strip().lower()
-    return v not in ("0", "false", "off", "no")
+    return bool(knobs.get("RDT_SHUFFLE_CONSOLIDATE"))
 
 
 def _pipeline_enabled() -> bool:
@@ -118,8 +117,7 @@ def _pipeline_enabled() -> bool:
     action like ``RDT_ETL_AQE``. The mode requires the consolidated
     per-bucket index, so ``RDT_SHUFFLE_CONSOLIDATE=0`` cleanly disables it
     too (doc/etl.md "Pipelined shuffle")."""
-    v = os.environ.get("RDT_SHUFFLE_PIPELINE", "1").strip().lower()
-    return v not in ("0", "false", "off", "no")
+    return bool(knobs.get("RDT_SHUFFLE_PIPELINE"))
 
 
 def _free_result_refs(results: Sequence[Optional[Dict[str, Any]]]) -> None:
@@ -141,18 +139,17 @@ _DRAIN_TIMEOUT_S = 30.0
 
 def _recovery_enabled() -> bool:
     """Lineage recovery kill switch; read per action so tests can flip it."""
-    v = os.environ.get("RDT_LINEAGE_RECOVERY", "1").strip().lower()
-    return v not in ("0", "false", "off", "no")
+    return bool(knobs.get("RDT_LINEAGE_RECOVERY"))
 
 
 def _recovery_rounds() -> int:
     """Recovery attempts per stage (each round may regenerate several blobs)."""
-    return int(os.environ.get("RDT_LINEAGE_ROUNDS", "4") or 0)
+    return int(knobs.get("RDT_LINEAGE_ROUNDS"))
 
 
 def _recovery_depth() -> int:
     """Max transitive producer-of-producer regeneration depth."""
-    return int(os.environ.get("RDT_LINEAGE_DEPTH", "4") or 0)
+    return int(knobs.get("RDT_LINEAGE_DEPTH"))
 
 
 def _unreachable_grace_s() -> float:
@@ -161,7 +158,7 @@ def _unreachable_grace_s() -> float:
     tens of seconds on a loaded machine — so "cannot reach" must not burn the
     task-retry budget (~7s of capped backoff): submits rotate to live
     executors immediately and only give up after this wall-clock grace."""
-    return float(os.environ.get("RDT_EXECUTOR_WAIT_S", "60") or 0)
+    return float(knobs.get("RDT_EXECUTOR_WAIT_S"))
 
 
 # ---- speculation knobs (read per stage, so tests/benches can flip them) ----
@@ -169,27 +166,26 @@ def _speculation_enabled() -> bool:
     """Speculative-backup kill switch (default ON). Safe by construction:
     task reruns are byte-identical, so either copy's bytes are valid — the
     loser's distinct store blobs are drained and freed, never ledgered."""
-    v = os.environ.get("RDT_SPECULATION", "1").strip().lower()
-    return v not in ("0", "false", "off", "no")
+    return bool(knobs.get("RDT_SPECULATION"))
 
 
 def _speculation_quantile() -> float:
     """Completion fraction a stage must reach before backups are considered
     (LATE-style gate: a median runtime only means something once most of the
     stage has finished)."""
-    return float(os.environ.get("RDT_SPECULATION_QUANTILE", "0.75") or 0.75)
+    return float(knobs.get("RDT_SPECULATION_QUANTILE"))
 
 
 def _speculation_multiplier() -> float:
     """A pending attempt is a straggler when its runtime exceeds this
     multiple of the completed-task median."""
-    return float(os.environ.get("RDT_SPECULATION_MULTIPLIER", "1.5") or 1.5)
+    return float(knobs.get("RDT_SPECULATION_MULTIPLIER"))
 
 
 def _speculation_min_s() -> float:
     """Floor on the straggler threshold: sub-second stages never speculate
     just because their median is tiny."""
-    return float(os.environ.get("RDT_SPECULATION_MIN_S", "1.0") or 1.0)
+    return float(knobs.get("RDT_SPECULATION_MIN_S"))
 
 
 class _Attempt:
@@ -241,8 +237,9 @@ class _StreamStageRec:
         self.start_ts = time.time()
         #: per map: (consolidated ref, per-bucket (off, size, rows) index)
         #: of the LATEST generation (a regenerated producer re-seals here)
-        self.seals: List[Optional[Tuple[ObjectRef, list]]] = [None] * num_maps
-        self.gens = [0] * num_maps
+        self.seals: List[Optional[Tuple[ObjectRef, list]]] = \
+            [None] * num_maps  # guarded-by: _lock
+        self.gens = [0] * num_maps  # guarded-by: _lock
         self.thread: Optional[threading.Thread] = None
         self.done = threading.Event()
         self.error: Optional[BaseException] = None
@@ -314,7 +311,7 @@ class _ActionTemps(list):
         #: in this action; anything serialized for later use (e.g. cache
         #: recover recipes) must be patched through this map, or it would
         #: bake in ids whose blobs are already dead
-        self.ref_patches: Dict[str, ObjectRef] = {}
+        self.ref_patches: Dict[str, ObjectRef] = {}  # guarded-by: _patch_lock
         #: label → the report entry THIS action recorded (aliases the dict in
         #: the engine deque), so recovery attribution lands on this action's
         #: stage even when a concurrent action logged the same label later
@@ -437,8 +434,8 @@ class ExecutorPool:
             if h.name and h.name in self.hosts_by_name:
                 self._names_by_host.setdefault(
                     self.hosts_by_name[h.name], []).append(h.name)
-        self._rr = 0
-        self._local_rr: Dict[str, int] = {}
+        self._rr = 0  # guarded-by: _lock
+        self._local_rr: Dict[str, int] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     @staticmethod
@@ -950,6 +947,7 @@ class Engine:
         self._report_lock = threading.Lock()
         # bounded per-engine shuffle-stage ledger (one entry per wide-op
         # stage); benchmarks and tests read it through shuffle_stage_report()
+        # guarded-by: _report_lock
         self._stage_reports: "collections.deque[Dict[str, Any]]" = \
             collections.deque(maxlen=256)
         self._retry_rng = random.Random()  # jitter for recovery resubmits

@@ -33,12 +33,12 @@ class BlockCache:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._blocks: Dict[str, pa.Table] = {}
+        self._blocks: Dict[str, pa.Table] = {}  # guarded-by: _lock
         #: per-put generation stamp — a drop conditioned on a stamp only
         #: removes the exact entry its caller saw, so a drain-abandoned
         #: straggler's deferred cleanup can't delete the live block a
         #: recovery resubmit of the same task cached under the same key
-        self._stamps: Dict[str, Optional[str]] = {}
+        self._stamps: Dict[str, Optional[str]] = {}  # guarded-by: _lock
 
     def get(self, key: str) -> Optional[pa.Table]:
         with self._lock:
@@ -121,7 +121,8 @@ class BroadcastCache:
     def __init__(self, max_entries: int = 4):
         self._lock = threading.Lock()
         self._max = max_entries
-        self._tables: "dict" = {}  # insertion-ordered (LRU via re-insert)
+        # guarded-by: _lock; insertion-ordered (LRU via re-insert)
+        self._tables: "dict" = {}
 
     def get_or_load(self, key, loader):
         with self._lock:

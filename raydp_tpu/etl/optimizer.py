@@ -33,6 +33,7 @@ from typing import List, Optional
 
 import pyarrow as pa
 
+from raydp_tpu import knobs
 from raydp_tpu.etl import plan as P
 from raydp_tpu.etl.expressions import Column, Expr, col, substitute_columns
 
@@ -43,8 +44,7 @@ DECOMPOSABLE_AGGS = {"count", "sum", "min", "max", "mean"}
 
 
 def enabled() -> bool:
-    return os.environ.get("RDT_ETL_OPTIMIZER", "1").lower() not in (
-        "0", "false", "off", "no")
+    return bool(knobs.get("RDT_ETL_OPTIMIZER"))
 
 
 # ==== adaptive query execution (AQE) knobs =========================================
@@ -57,8 +57,7 @@ def enabled() -> bool:
 def aqe_enabled() -> bool:
     """Adaptive-execution master switch (default ON, ``RDT_ETL_AQE=0`` off).
     Read per action like ``RDT_ETL_OPTIMIZER``."""
-    return os.environ.get("RDT_ETL_AQE", "1").lower() not in (
-        "0", "false", "off", "no")
+    return bool(knobs.get("RDT_ETL_AQE"))
 
 
 def aqe_broadcast_max() -> int:
@@ -66,15 +65,14 @@ def aqe_broadcast_max() -> int:
     bytes fit under this skips its shuffle entirely and replicates to every
     executor instead (default ~8MB, Spark's autoBroadcastJoinThreshold
     ballpark). 0 disables rule (a)."""
-    return int(float(os.environ.get("RDT_AQE_BROADCAST_MAX",
-                                    str(8 << 20)) or 0))
+    return int(knobs.get("RDT_AQE_BROADCAST_MAX"))
 
 
 def aqe_skew_factor() -> float:
     """Skew-mitigation trigger: a reduce bucket whose measured bytes exceed
     this multiple of the median bucket splits its byte-ranges across several
     reduce tasks. 0 disables rule (b)."""
-    return float(os.environ.get("RDT_AQE_SKEW_FACTOR", "4") or 0)
+    return float(knobs.get("RDT_AQE_SKEW_FACTOR"))
 
 
 def aqe_coalesce_min() -> int:
@@ -83,8 +81,7 @@ def aqe_coalesce_min() -> int:
     1MB), so many-bucket configs stop paying a dispatch per kilobyte-sized
     bucket. Doubles as the floor under which a bucket is never worth skew-
     splitting. 0 disables rule (c) (and the split floor)."""
-    return int(float(os.environ.get("RDT_AQE_COALESCE_MIN",
-                                    str(1 << 20)) or 0))
+    return int(knobs.get("RDT_AQE_COALESCE_MIN"))
 
 
 def estimate_plan_bytes(node: P.PlanNode) -> Optional[int]:

@@ -58,6 +58,22 @@ ENV_SEED = "RDT_FAULTS_SEED"
 #: while injecting nothing
 KNOWN_ACTIONS = frozenset(("crash", "delay", "raise", "drop", "connloss"))
 
+#: every site the runtime actually arms (the ``faults.check(...)`` call
+#: sites). parse_spec rejects env-spec sites outside this registry — a chaos
+#: schedule aimed at a renamed/typo'd site used to arm nothing, silently.
+#: The programmatic :func:`inject` stays permissive: unit tests arm synthetic
+#: sites (``unit.site``) to test the plane itself. Kept in sync with code,
+#: doc/fault_tolerance.md's site table, and test specs by rdtlint's
+#: ``fault-site-sync`` rule.
+KNOWN_SITES = frozenset((
+    "executor.run_task",
+    "shuffle.write",
+    "shuffle.fetch",
+    "store.get",
+    "rpc.call",
+    "estimator.epoch",
+))
+
 #: the site-specific actions and the only call sites that interpret them —
 #: crash/delay/raise are generic (any site routes them through apply());
 #: a drop armed at rpc.call would claim its sentinel and inject nothing,
@@ -188,6 +204,10 @@ def parse_spec(spec: str, default_seed: int = 0,
         if len(parts) < 2:
             raise ValueError(f"fault rule needs site:action, got {raw!r}")
         site, action = parts[0].strip(), parts[1].strip()
+        if site not in KNOWN_SITES:
+            raise ValueError(
+                f"unknown fault site {site!r} (known: "
+                f"{', '.join(sorted(KNOWN_SITES))}) in rule {raw!r}")
         kw: Dict[str, object] = {"seed": default_seed,
                                  "index": start_index + len(rules)}
         for opt in parts[2:]:
@@ -235,7 +255,13 @@ class FaultPlane:
         with self._lock:
             if self._env_loaded:
                 return
+            # both knobs ARE declared in raydp_tpu/knobs.py, but this module
+            # must stay stdlib-only and importable before the package
+            # (actor bootstrap), so it reads the env directly; init()
+            # re-arms from the current env
+            # rdtlint: allow[knob-registry] bootstrap module, stdlib-only
             spec = os.environ.get(ENV_FAULTS, "")
+            # rdtlint: allow[knob-registry] bootstrap module, stdlib-only
             seed = int(os.environ.get(ENV_SEED, "0") or 0)
             if spec:
                 # after reset() the registry may still hold inject()-ed
@@ -334,6 +360,9 @@ def apply(rule: FaultRule, site: str = "", nbytes: int = 0) -> None:
     if rule.action == "crash":
         crash_process()
     elif rule.action == "delay":
+        # an injected delay IS the fault: chaos schedules deliberately stall
+        # the serving thread to model a slow peer, bounded by ms/ms_per_mb
+        # rdtlint: allow[dispatcher-blocking] injected delay is the fault
         time.sleep((rule.ms + rule.ms_per_mb * nbytes / float(1 << 20))
                    / 1000.0)
     elif rule.action == "raise":

@@ -1,0 +1,331 @@
+"""Central registry of every ``RDT_*`` environment knob.
+
+Knobs accumulated across the repo one PR at a time — opt-outs, thresholds,
+budgets, grace periods — and each one carried its own ad-hoc ``os.environ``
+read with its own parsing quirks and its own chance of doc drift. This module
+is the single source of truth: every knob's **name, type, default, and read
+scope** is declared here, every runtime read goes through :func:`get` (or
+:func:`require` for framework-injected values that must exist), and the doc
+tables in ``doc/etl.md`` / ``doc/training.md`` are GENERATED from this
+registry (``python -m raydp_tpu.knobs --write-docs``).
+
+The project linter (``raydp_tpu/tools/rdtlint``, rule ``knob-registry``)
+enforces the contract statically:
+
+- a direct ``os.environ`` read of an ``RDT_*`` name anywhere else in the
+  package is a violation (the PR 3 ``RDT_FAULTS`` re-arm bug class started as
+  exactly such a scattered read);
+- reading a **per-action** knob at import time (module or class scope, or a
+  function default) is a violation — per-action knobs exist so tests and
+  benches can flip them at runtime, and an import-time cache silently pins
+  the first value a process ever saw;
+- the generated doc tables must match this registry byte-for-byte.
+
+Read scopes:
+
+- ``per-action`` — re-read from the environment at every use (every engine
+  action, every feed/iterator construction, every stage). Flipping the env
+  var mid-session takes effect on the next action.
+- ``process-start`` — read once per process (at import, process bootstrap,
+  or session init). Changing the env var requires a new process (for
+  ``RDT_FAULTS``: a new :func:`raydp_tpu.init`, which re-arms the plane).
+
+This module must stay stdlib-only with no ``raydp_tpu`` imports: it is read
+by bootstrap paths (node agents, rank workers) and loaded standalone by the
+linter without spinning up the runtime.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+PER_ACTION = "per-action"
+PROCESS_START = "process-start"
+
+#: the truthiness convention every boolean knob shares (``RDT_X=0`` /
+#: ``false`` / ``off`` / ``no`` disables; anything else — including the
+#: conventional ``1`` — enables)
+_FALSY = ("0", "false", "off", "no")
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One declared environment knob."""
+
+    name: str
+    type: str          # "bool" | "int" | "float" | "str"
+    default: object    # typed default; None = unset (or computed at the site)
+    scope: str         # PER_ACTION | PROCESS_START
+    category: str      # "etl" | "training" | "runtime" | "faults" | "spmd"
+    doc: str           # one-line description for the generated doc tables
+    #: framework-injected IPC value (set by the head/agent/submit wrapper for
+    #: child processes), not a user-facing tuning knob
+    internal: bool = False
+    #: display override for computed defaults (e.g. "sized from /dev/shm")
+    default_doc: str = ""
+
+    def parse(self, raw: str) -> object:
+        if self.type == "bool":
+            return raw.strip().lower() not in _FALSY
+        if self.type == "int":
+            # int(float(...)) so "8e6"-style and "2048.0"-style values work
+            return int(float(raw))
+        if self.type == "float":
+            return float(raw)
+        return raw
+
+
+def _k(name: str, type: str, default: object, scope: str, category: str,
+       doc: str, **kw) -> Knob:
+    return Knob(name=name, type=type, default=default, scope=scope,
+                category=category, doc=doc, **kw)
+
+
+#: declaration order is presentation order in the generated tables
+_ALL = [
+    # ---- ETL engine ---------------------------------------------------------
+    _k("RDT_ETL_OPTIMIZER", "bool", True, PER_ACTION, "etl",
+       "Rule-based logical-plan optimizer (projection pruning + predicate "
+       "pushdown); 0 preserves the naive compile-verbatim path."),
+    _k("RDT_ETL_AQE", "bool", True, PER_ACTION, "etl",
+       "Adaptive query execution: runtime re-planning from measured stage "
+       "statistics (broadcast join, skew split, coalesce)."),
+    _k("RDT_AQE_BROADCAST_MAX", "int", 8 << 20, PER_ACTION, "etl",
+       "Broadcast-hash-join threshold: a join side whose measured bytes fit "
+       "under this replicates instead of shuffling. 0 disables the rule."),
+    _k("RDT_AQE_SKEW_FACTOR", "float", 4.0, PER_ACTION, "etl",
+       "Skew trigger: a reduce bucket larger than this multiple of the "
+       "(lower) median bucket splits across reduce tasks. 0 disables."),
+    _k("RDT_AQE_COALESCE_MIN", "int", 1 << 20, PER_ACTION, "etl",
+       "Coalescing target: adjacent reduce buckets fuse until their combined "
+       "bytes reach this; also the floor under which a bucket never "
+       "skew-splits. 0 disables."),
+    _k("RDT_SHUFFLE_CONSOLIDATE", "bool", True, PER_ACTION, "etl",
+       "Consolidated map outputs: one store blob per map task with a "
+       "per-bucket byte-range index; 0 restores per-bucket blobs."),
+    _k("RDT_SHUFFLE_PIPELINE", "bool", True, PER_ACTION, "etl",
+       "Pipelined (push-based) shuffle: reducers stream ranges as maps seal. "
+       "Needs the consolidated index, so RDT_SHUFFLE_CONSOLIDATE=0 disables "
+       "it too."),
+    _k("RDT_LINEAGE_RECOVERY", "bool", True, PER_ACTION, "etl",
+       "Lineage rebuild of lost intermediates; 0 surfaces losses as stage "
+       "failures."),
+    _k("RDT_LINEAGE_ROUNDS", "int", 4, PER_ACTION, "etl",
+       "Recovery rounds per stage (each round may regenerate several "
+       "blobs)."),
+    _k("RDT_LINEAGE_DEPTH", "int", 4, PER_ACTION, "etl",
+       "Max transitive producer-of-producer regeneration depth."),
+    _k("RDT_EXECUTOR_WAIT_S", "float", 60.0, PER_ACTION, "etl",
+       "Wall-clock grace a stage keeps probing for a reachable executor "
+       "(sized for restart spawn + jax import) before failing."),
+    _k("RDT_SPECULATION", "bool", True, PER_ACTION, "etl",
+       "Speculative backup tasks for stragglers; first finisher wins, the "
+       "loser's outputs are freed."),
+    _k("RDT_SPECULATION_QUANTILE", "float", 0.75, PER_ACTION, "etl",
+       "Completion fraction a stage must reach before backups are "
+       "considered."),
+    _k("RDT_SPECULATION_MULTIPLIER", "float", 1.5, PER_ACTION, "etl",
+       "A pending attempt is a straggler past this multiple of the "
+       "completed-task median runtime."),
+    _k("RDT_SPECULATION_MIN_S", "float", 1.0, PER_ACTION, "etl",
+       "Floor on the straggler threshold: sub-second stages never "
+       "speculate."),
+    # ---- training / feed ----------------------------------------------------
+    _k("RDT_PREFETCH_TO_DEVICE", "int", 2, PER_ACTION, "training",
+       "Already-device_put batches the streaming feed keeps ahead of the "
+       "train step (0 = place synchronously)."),
+    _k("RDT_FEED_CACHE_MB", "float", 2048.0, PER_ACTION, "training",
+       "Per-iterator budget (MiB) for the decoded-block host cache reused "
+       "across epochs."),
+    _k("RDT_DEVICE_CACHE", "bool", True, PER_ACTION, "training",
+       "Device-resident dataset cache opt-out (0 always streams batches)."),
+    _k("RDT_DEVICE_CACHE_MB", "float", 2048.0, PER_ACTION, "training",
+       "HBM budget (MiB) under which a dataset is eligible for full "
+       "device residency."),
+    _k("RDT_STAGE_THREADS", "int", 1, PER_ACTION, "training",
+       "Column fan-out threads of the native staging core (host decode)."),
+    # ---- runtime ------------------------------------------------------------
+    _k("RDT_LOG_LEVEL", "str", "INFO", PROCESS_START, "runtime",
+       "Log level of spawned processes (node agents, SPMD rank workers)."),
+    _k("RDT_DRIVER_REAP_S", "float", 60.0, PROCESS_START, "runtime",
+       "Heartbeat silence after which an attached driver's actors and owned "
+       "objects are reaped by the head."),
+    _k("RDT_ARENA_FREE_GRACE_S", "float", 60.0, PROCESS_START, "runtime",
+       "Seconds an arena-resident payload stays mapped after its free "
+       "(borrowed zero-copy views may still be live)."),
+    _k("RDT_PROFILER_MAX_SPANS", "int", 100000, PROCESS_START, "runtime",
+       "Bound on retained trace spans per process."),
+    _k("RDT_STORE_ISOLATED", "bool", False, PROCESS_START, "runtime",
+       "Force a node agent to host its own payload plane even on the head's "
+       "machine (the multi-host store topology, in tests)."),
+    _k("RDT_NODE_SHM_BUDGET", "int", None, PROCESS_START, "runtime",
+       "Shared-memory budget (bytes) of an isolated node's store host; "
+       "objects past it LRU-spill to disk.",
+       default_doc="node arena size (1 GiB fallback)"),
+    _k("RDT_NODE_ARENA_SIZE", "int", None, PROCESS_START, "runtime",
+       "Size (bytes) of an isolated node's store arena.",
+       default_doc="sized from /dev/shm"),
+    _k("RDT_STORE_HOST_ID", "str", "head", PROCESS_START, "runtime",
+       "Which machine's payload plane this process writes to.",
+       internal=True),
+    _k("RDT_STORE_PAYLOAD_ADDR", "str", None, PROCESS_START, "runtime",
+       "RPC address of this machine's payload server (None = the head).",
+       internal=True),
+    _k("RDT_STORE_ARENA", "str", None, PROCESS_START, "runtime",
+       "Shared-memory segment name of the machine-local store arena.",
+       internal=True),
+    _k("RDT_SUBMIT_ARGS", "str", None, PROCESS_START, "runtime",
+       "JSON config packaged by rdt-submit; fills init() arguments left at "
+       "their defaults.", internal=True),
+    # ---- fault plane --------------------------------------------------------
+    _k("RDT_FAULTS", "str", None, PROCESS_START, "faults",
+       "Declarative fault-injection spec (doc/fault_tolerance.md); loaded "
+       "once per process, re-armed by raydp_tpu.init()."),
+    _k("RDT_FAULTS_SEED", "int", 0, PROCESS_START, "faults",
+       "Global default PRNG seed for probability-scheduled fault rules."),
+    # ---- SPMD gang plumbing -------------------------------------------------
+    _k("RDT_SPMD_JOB_ID", "str", None, PROCESS_START, "spmd",
+       "Gang job id of an SPMD rank worker.", internal=True),
+    _k("RDT_SPMD_DRIVER", "str", None, PROCESS_START, "spmd",
+       "RPC url of the gang driver a rank worker reports to.",
+       internal=True),
+    _k("RDT_SPMD_RANK", "int", None, PROCESS_START, "spmd",
+       "This worker's rank in the gang.", internal=True),
+    _k("RDT_SPMD_WORLD_SIZE", "int", None, PROCESS_START, "spmd",
+       "Gang world size.", internal=True),
+    _k("RDT_SPMD_COORDINATOR", "str", None, PROCESS_START, "spmd",
+       "jax.distributed coordinator address override.", internal=True),
+    _k("RDT_SPMD_JAX_DISTRIBUTED", "bool", False, PROCESS_START, "spmd",
+       "Whether a rank worker calls jax.distributed.initialize().",
+       internal=True),
+]
+
+KNOBS: Dict[str, Knob] = {k.name: k for k in _ALL}
+assert len(KNOBS) == len(_ALL), "duplicate knob declaration"
+
+
+def get(name: str):
+    """The typed value of knob ``name`` read from the environment NOW, or
+    its declared default when unset or empty (empty string = unset, so
+    ``RDT_X= python ...`` behaves like an absent var, never a parse error).
+
+    Call-time reads are what keep per-action semantics: call sites must not
+    stash the result at import time (rule ``knob-registry`` flags it)."""
+    knob = KNOBS[name]
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return knob.default
+    return knob.parse(raw)
+
+
+def get_raw(name: str) -> Optional[str]:
+    """The raw environment string of a declared knob (None when unset).
+    For sites that need the unparsed value (e.g. JSON payloads)."""
+    KNOBS[name]  # unknown name must fail loudly, same as get()
+    return os.environ.get(name)
+
+
+def require(name: str):
+    """Like :func:`get` but raises when the var is unset — for
+    framework-injected values (SPMD rank plumbing) whose absence means the
+    process was launched outside its harness."""
+    knob = KNOBS[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        raise KeyError(
+            f"{name} is not set — this process expects it injected by its "
+            f"launcher ({knob.doc})")
+    return knob.parse(raw)
+
+
+# ---- generated doc tables ---------------------------------------------------
+
+def _default_cell(knob: Knob) -> str:
+    if knob.default is None:
+        return knob.default_doc or "unset"
+    if knob.type == "bool":
+        return f"`{'1' if knob.default else '0'}`"
+    return f"`{knob.default}`"
+
+
+def generate_table(category: Optional[str] = None) -> str:
+    """Markdown knob table for one category (None = the full registry).
+    The doc blocks between ``rdtlint:knob-table`` markers are exactly this
+    output; rule ``knob-registry`` fails on any drift."""
+    rows = [k for k in _ALL if category is None or k.category == category]
+    lines = ["| Knob | Type | Default | Read | Description |",
+             "| --- | --- | --- | --- | --- |"]
+    for k in rows:
+        doc = k.doc + (" *(framework-injected)*" if k.internal else "")
+        lines.append(f"| `{k.name}` | {k.type} | {_default_cell(k)} | "
+                     f"{k.scope} | {doc} |")
+    return "\n".join(lines)
+
+
+#: which doc file carries which category's generated table; dev_lint.md
+#: carries the full registry
+DOC_TABLES = (
+    ("doc/etl.md", "etl"),
+    ("doc/training.md", "training"),
+    ("doc/dev_lint.md", None),
+)
+
+_BEGIN = "<!-- rdtlint:knob-table:begin {tag} -->"
+_END = "<!-- rdtlint:knob-table:end -->"
+
+
+def table_markers(category: Optional[str]) -> tuple:
+    return _BEGIN.format(tag=category or "all"), _END
+
+
+def render_block(category: Optional[str]) -> str:
+    begin, end = table_markers(category)
+    return f"{begin}\n{generate_table(category)}\n{end}"
+
+
+def write_doc_tables(root: str) -> list:
+    """Rewrite every marker block under ``root`` from the registry; returns
+    the files changed. Used by ``python -m raydp_tpu.knobs --write-docs``."""
+    changed = []
+    for rel, category in DOC_TABLES:
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+        begin, end = table_markers(category)
+        if begin not in text or end not in text:
+            continue
+        head, rest = text.split(begin, 1)
+        _, tail = rest.split(end, 1)
+        new = head + render_block(category) + tail
+        if new != text:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(new)
+            changed.append(rel)
+    return changed
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m raydp_tpu.knobs",
+        description="print or regenerate the RDT_* knob tables")
+    ap.add_argument("--write-docs", action="store_true",
+                    help="rewrite the generated doc tables in place")
+    ap.add_argument("--root", default=".",
+                    help="repo root holding doc/ (default: cwd)")
+    args = ap.parse_args(argv)
+    if args.write_docs:
+        for rel in write_doc_tables(args.root):
+            print(f"rewrote {rel}")
+        return 0
+    print(generate_table())
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - thin CLI shim
+    raise SystemExit(main())

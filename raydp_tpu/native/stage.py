@@ -32,6 +32,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 import pyarrow as pa
 
+from raydp_tpu import knobs
 from raydp_tpu.log import get_logger
 
 logger = get_logger("native.stage")
@@ -176,7 +177,7 @@ def stage_table(table: pa.Table, columns: Sequence[str],
         n = len(plans)
         src_arr = (ctypes.c_void_p * n)(*[p[0][0] for p in plans])
         code_arr = (ctypes.c_int * n)(*[p[0][1] for p in plans])
-        threads = int(os.environ.get("RDT_STAGE_THREADS", "1"))
+        threads = int(knobs.get("RDT_STAGE_THREADS"))
         if lib.rdt_stage_columns(src_arr, code_arr, n, rows, dst_ptr,
                                  dst_code, threads):
             return None

@@ -28,10 +28,12 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from raydp_tpu import knobs
+
 _lock = threading.Lock()
 # bounded ring: long-lived actors trace every task (etl/executor.py), so an
 # unbounded list would grow for the life of the process; oldest spans drop
-MAX_SPANS = int(os.environ.get("RDT_PROFILER_MAX_SPANS", "100000"))
+MAX_SPANS = int(knobs.get("RDT_PROFILER_MAX_SPANS"))
 _spans: "collections.deque[Dict[str, Any]]" = collections.deque(
     maxlen=MAX_SPANS)
 _enabled = True

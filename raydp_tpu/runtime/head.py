@@ -35,6 +35,7 @@ from raydp_tpu.runtime.actor import (
 )
 from raydp_tpu.runtime.object_store import ObjectStoreClient, ObjectStoreServer
 from raydp_tpu.runtime.placement import PlacementGroup, PlacementStrategy, ResourceManager
+from raydp_tpu import knobs
 from raydp_tpu.runtime.rpc import MethodDispatcher, RpcServer
 
 logger = get_logger("head")
@@ -399,12 +400,12 @@ class RuntimeContext:
         self.store_server.node_fault_in = self._node_store_fault_in
         self.store_server.node_remove_spill = self._node_store_remove_spill
         self._lock = threading.RLock()
-        self._waiters: List[tuple] = []  # (deadline, timeout, id, fut, mode)
+        # guarded-by: _waiters_lock; (deadline, timeout, id, fut, mode)
+        self._waiters: List[tuple] = []
         self._waiters_lock = threading.Lock()
         #: attach-mode drivers: driver_id → last heartbeat monotonic time
-        self._drivers: Dict[str, float] = {}
-        self.driver_reap_after_s = float(
-            os.environ.get("RDT_DRIVER_REAP_S", "60"))
+        self._drivers: Dict[str, float] = {}  # guarded-by: _lock
+        self.driver_reap_after_s = float(knobs.get("RDT_DRIVER_REAP_S"))
         self._stopped = threading.Event()
 
         self.service = HeadService(self)
@@ -597,6 +598,9 @@ class RuntimeContext:
             if overrides.get("PYTHONPATH"):
                 driver_path.append(overrides["PYTHONPATH"])
             overrides["PYTHONPATH"] = os.pathsep.join(driver_path)
+            # one bounded (30s) hop to a peer whose spawn handler never
+            # calls back into the head's pool — no self-deadlock feedback
+            # rdtlint: allow[dispatcher-blocking] bounded agent spawn hop
             pid = agent.call("spawn", overrides, log_name, timeout=30.0)
             rec.process = _RemoteProcess(agent, pid, rec.node_id)
         else:

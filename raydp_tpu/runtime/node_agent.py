@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from raydp_tpu import knobs
 from raydp_tpu.log import get_logger, init_logging
 from raydp_tpu.runtime.rpc import MethodDispatcher, RpcServer, connect_with_retry
 
@@ -166,7 +167,7 @@ class NodeAgent:
         self._lock = threading.Lock()
         self._stopped = threading.Event()
 
-        store_isolated = os.environ.get("RDT_STORE_ISOLATED") == "1"
+        store_isolated = bool(knobs.get("RDT_STORE_ISOLATED"))
         reply = self.head.call(
             "register_node_agent", self.server.address[0],
             self.server.address[1], dict(resources), self.head.local_host,
@@ -190,9 +191,10 @@ class NodeAgent:
             info = self.payload_host.arena_info()
             # this machine's shm budget: objects past it LRU-spill to the
             # node's spill dir under the head's direction
-            budget = int(os.environ.get(
-                "RDT_NODE_SHM_BUDGET",
-                info["size"] if info else (1 << 30)))
+            budget = knobs.get("RDT_NODE_SHM_BUDGET")
+            if budget is None:
+                budget = info["size"] if info else (1 << 30)
+            budget = int(budget)
             self.head.call("register_store_host", self.node_id,
                            info["segment"] if info else None, budget)
         logger.info("node agent %s registered with %s (resources=%s, store=%s)",
@@ -205,8 +207,8 @@ class NodeAgent:
         try:
             from raydp_tpu.native.arena import Arena
             from raydp_tpu.runtime.head import _default_arena_size
-            size = int(os.environ.get("RDT_NODE_ARENA_SIZE",
-                                      _default_arena_size()))
+            size = knobs.get("RDT_NODE_ARENA_SIZE")
+            size = int(size) if size is not None else _default_arena_size()
             arena = Arena.create(f"rdt{self.session_id[:8]}_n{os.getpid()}",
                                  size)
             logger.info("node-local store arena: %s (%d MiB)",
@@ -349,8 +351,7 @@ def main() -> None:
         name, _, amount = item.partition("=")
         resources[name] = float(amount or 1.0)
 
-    init_logging("node-agent", os.environ.get("RDT_LOG_LEVEL", "INFO"),
-                 None, None)
+    init_logging("node-agent", str(knobs.get("RDT_LOG_LEVEL")), None, None)
     agent = NodeAgent(args.head, resources, log_dir=args.log_dir)
     agent.serve_forever()
 

@@ -47,6 +47,7 @@ import pyarrow as pa
 
 from raydp_tpu import faults
 from raydp_tpu.log import get_logger
+from raydp_tpu import knobs
 from raydp_tpu.runtime.rpc import DeferredReply
 
 logger = get_logger("object_store")
@@ -120,12 +121,13 @@ class ShuffleStreamLedger:
     def __init__(self):
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._stages: Dict[str, _StreamStage] = {}
+        self._stages: Dict[str, _StreamStage] = {}  # guarded-by: _lock
+        # guarded-by: _lock
         self._closed: "collections.OrderedDict[str, bool]" = \
             collections.OrderedDict()
-        self._waiters: List[Dict[str, Any]] = []
-        self._sweeper: Optional[threading.Thread] = None
-        self._stopped = False
+        self._waiters: List[Dict[str, Any]] = []  # guarded-by: _lock
+        self._sweeper: Optional[threading.Thread] = None  # guarded-by: _lock
+        self._stopped = False  # guarded-by: _lock
 
     # -- driver side ----------------------------------------------------------
     def begin(self, stage_key: str, num_maps: int) -> None:
@@ -197,12 +199,12 @@ class ShuffleStreamLedger:
         return DeferredReply(fut)
 
     # -- internals ------------------------------------------------------------
-    def _empty_locked(self, stage_key: str) -> Dict[str, Any]:
+    def _empty_locked(self, stage_key: str) -> Dict[str, Any]:  # guarded-by: _lock
         st = self._stages.get(stage_key)
         return {"events": [], "aborted": None,
                 "expected": st.num_maps if st is not None else None}
 
-    def _resp_locked(self, stage_key: str, bucket: int,
+    def _resp_locked(self, stage_key: str, bucket: int,  # guarded-by: _lock
                      have: Dict[int, int]) -> Optional[Dict[str, Any]]:
         st = self._stages.get(stage_key)
         if st is None:
@@ -225,7 +227,7 @@ class ShuffleStreamLedger:
                     "expected": st.num_maps}
         return None
 
-    def _collect_ready_locked(self, stage_key: str
+    def _collect_ready_locked(self, stage_key: str  # guarded-by: _lock
                               ) -> List[Tuple[Future, Dict[str, Any]]]:
         ready, keep = [], []
         for w in self._waiters:
@@ -248,7 +250,7 @@ class ShuffleStreamLedger:
             if not fut.done():
                 fut.set_result(resp)
 
-    def _ensure_sweeper_locked(self) -> None:
+    def _ensure_sweeper_locked(self) -> None:  # guarded-by: _lock
         if self._sweeper is None or not self._sweeper.is_alive():
             self._sweeper = threading.Thread(
                 target=self._sweep_loop, daemon=True,
@@ -350,14 +352,14 @@ class PayloadHost:
     #: ``rdt_free`` would let a writer recycle bytes under a live view. The
     #: per-object-segment mode never had this hazard (unlink preserves mapped
     #: contents), so arena mode defers reclamation for a grace period instead.
-    ARENA_FREE_GRACE_S = float(os.environ.get("RDT_ARENA_FREE_GRACE_S", "60"))
+    ARENA_FREE_GRACE_S = float(knobs.get("RDT_ARENA_FREE_GRACE_S"))
 
     def __init__(self, arena=None):
         self._arena = arena
         # rdt_free/munmap on the arena base must not interleave: a supervisor
         # or RPC thread freeing a dead owner's blocks races session shutdown.
         self._arena_lock = threading.Lock()
-        self._deferred: List[Tuple[float, int]] = []  # (due time, offset)
+        self._deferred: List[Tuple] = []  # guarded-by: _arena_lock; (due, kind, payload)
 
     # -- arena ----------------------------------------------------------------
     def arena_info(self) -> Optional[Dict[str, Any]]:
@@ -493,7 +495,7 @@ class ObjectStoreServer:
         self.session_id = session_id
         self.host = PayloadHost(arena)
         self._lock = threading.Lock()
-        self._table: Dict[str, _Entry] = {}
+        self._table: Dict[str, _Entry] = {}  # guarded-by: _lock
         #: head-mediated payload RPC counters — the distributed-plane tests
         #: assert these stay flat while cross-node traffic flows node→node
         self.payload_rpc_count = 0
@@ -502,7 +504,7 @@ class ObjectStoreServer:
         # the point of batching; benchmarks read these to fence the
         # metadata-plane reduction of the consolidated shuffle path)
         self._op_lock = threading.Lock()
-        self._op_counts: Dict[str, int] = {}
+        self._op_counts: Dict[str, int] = {}  # guarded-by: _op_lock
         # callbacks wired by RuntimeContext for payloads on agent machines
         self.node_release = None  # (host_id, [(segment, offset)]) -> None
         self.node_fetch = None    # (host_id, segment, offset, size) -> bytes
@@ -511,8 +513,8 @@ class ObjectStoreServer:
         self.node_remove_spill = None  # (host_id, [oids]) -> None
         # per-node shm accounting (the head owns the table and the LRU
         # decision; the payload IO happens on the owning node)
-        self._host_bytes: Dict[str, int] = {}
-        self._host_budgets: Dict[str, int] = {}
+        self._host_bytes: Dict[str, int] = {}  # guarded-by: _lock
+        self._host_budgets: Dict[str, int] = {}  # guarded-by: _lock
         # eviction/spill (plasma parity): sealed head-host objects LRU-spill
         # to disk once their shm footprint exceeds the budget; lookups fault
         # them back in transparently. Disabled when spill_dir is None.
@@ -609,20 +611,21 @@ class ObjectStoreServer:
 
     def register_node_budget(self, host_id: str, budget: Optional[int]) -> None:
         if budget:
-            self._host_budgets[host_id] = int(budget)
+            with self._lock:
+                self._host_budgets[host_id] = int(budget)
 
     def _budget_of(self, host_id: str) -> Optional[int]:
         if host_id == HEAD_HOST:
             return self.shm_budget if self.spill_dir is not None else None
-        return self._host_budgets.get(host_id) \
-            if self.node_spill is not None else None
+        with self._lock:
+            return self._host_budgets.get(host_id) \
+                if self.node_spill is not None else None
 
-    def _shm_used(self, host_id: str) -> int:
+    def _shm_used(self, host_id: str) -> int:  # guarded-by: _lock
         return self._shm_bytes if host_id == HEAD_HOST \
             else self._host_bytes.get(host_id, 0)
 
-    def _adjust_shm(self, host_id: str, delta: int) -> None:
-        """Caller holds self._lock."""
+    def _adjust_shm(self, host_id: str, delta: int) -> None:  # guarded-by: _lock
         if host_id == HEAD_HOST:
             self._shm_bytes += delta
         else:
@@ -1147,9 +1150,9 @@ class ObjectStoreClient:
         # the head). Writes land in the machine-local arena/segments; reads
         # of objects on OTHER machines go directly to the owning node.
         self.host_id = (host_id if host_id is not None
-                        else os.environ.get(ENV_STORE_HOST_ID, HEAD_HOST))
+                        else str(knobs.get(ENV_STORE_HOST_ID)))
         self.payload_addr = (payload_addr if payload_addr is not None
-                             else os.environ.get(ENV_STORE_PAYLOAD_ADDR))
+                             else knobs.get(ENV_STORE_PAYLOAD_ADDR))
         self._peers: Dict[str, Any] = {}  # payload_addr -> RpcClient
         # remote mode (explicit constructor opt-in): this process has no
         # usable shared memory at all; every payload read and write is
@@ -1173,7 +1176,7 @@ class ObjectStoreClient:
                 return self._arena
             try:
                 if self.host_id != HEAD_HOST:
-                    segment = os.environ.get(ENV_STORE_ARENA)
+                    segment = knobs.get(ENV_STORE_ARENA)
                     if segment:
                         from raydp_tpu.native.arena import Arena
                         self._arena = Arena.attach(segment)

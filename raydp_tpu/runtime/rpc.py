@@ -234,8 +234,8 @@ class RpcClient:
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._send_lock = threading.Lock()
         self._pending_lock = threading.Lock()
-        self._pending: Dict[int, Future] = {}
-        self._next_id = 0
+        self._pending: Dict[int, Future] = {}  # guarded-by: _pending_lock
+        self._next_id = 0  # guarded-by: _pending_lock
         self._closed = False
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()

@@ -33,8 +33,11 @@ from typing import Any, Callable, Dict, List, Optional
 
 import cloudpickle
 
+from concurrent.futures import Future, InvalidStateError
+
 from raydp_tpu.log import get_logger
-from raydp_tpu.runtime.rpc import MethodDispatcher, RpcClient, RpcServer
+from raydp_tpu.runtime.rpc import (
+    DeferredReply, MethodDispatcher, RpcClient, RpcServer)
 
 logger = get_logger("spmd")
 
@@ -75,8 +78,12 @@ class _DriverService:
     def set_coordinator(self, address: str) -> bool:
         return self._job._on_set_coordinator(address)
 
-    def get_coordinator(self, timeout: float = 120.0) -> str:
-        return self._job._wait_coordinator(timeout)
+    def get_coordinator(self, timeout: float = 120.0):
+        # DeferredReply-based: every non-zero rank long-polls here while
+        # rank 0 is still importing jax — parking dispatchers on a condition
+        # wait would make set_coordinator queue behind the very waiters it
+        # must wake (pool exhaustion; rdtlint dispatcher-blocking)
+        return self._job._coordinator_reply(timeout)
 
     def ping(self) -> str:
         return "pong"
@@ -118,6 +125,10 @@ class SPMDJob:
         self._started = False
         self._placement_group_id: Optional[str] = None
         self._coordinator: Optional[str] = None
+        #: get_coordinator long-polls parked as futures — dispatcher threads
+        #: return immediately; each waiter holds one short-lived daemon
+        #: Timer for its deadline (gang-sized, never dispatcher-pool-sized)
+        self._coord_waiters: List[Future] = []
 
     # -- registration callbacks (driver service) ------------------------------
     def _on_register_worker(self, rank: int, pid: int) -> Dict[str, Any]:
@@ -141,19 +152,53 @@ class SPMDJob:
         machine."""
         with self._barrier:
             self._coordinator = address
+            waiters, self._coord_waiters = self._coord_waiters, []
             self._barrier.notify_all()
+        # complete OUTSIDE the lock: a done-callback (the RPC server's reply
+        # submit) must never run under it
+        for fut in waiters:
+            try:
+                fut.set_result(address)
+            except InvalidStateError:
+                pass  # lost the race to this waiter's timeout timer
         return True
 
-    def _wait_coordinator(self, timeout: float) -> str:
-        deadline = time.time() + timeout
+    def _coordinator_reply(self, timeout: float):
+        """The coordinator address immediately when known, else a
+        :class:`~raydp_tpu.runtime.rpc.DeferredReply` completed by rank 0's
+        ``set_coordinator`` (or failed at ``timeout``). Replaces a condition
+        wait that parked one dispatcher PER WAITING RANK: with the pool
+        sized below ``world_size - 1`` the ``set_coordinator`` call that
+        wakes the waiters would queue behind them — deadlock until every
+        waiter timed out."""
         with self._barrier:
-            while self._coordinator is None:
-                remaining = deadline - time.time()
-                if remaining <= 0:
-                    raise TimeoutError("coordinator address never arrived "
-                                       "(rank 0 dead before jax.distributed?)")
-                self._barrier.wait(timeout=min(1.0, remaining))
-            return self._coordinator
+            if self._coordinator is not None:
+                return self._coordinator
+            fut: Future = Future()
+            self._coord_waiters.append(fut)
+        timer = threading.Timer(timeout, self._coord_timeout, args=(fut,))
+        timer.daemon = True
+        timer.start()
+        fut.add_done_callback(lambda _f: timer.cancel())
+        return DeferredReply(fut)
+
+    def _coord_timeout(self, fut: "Future") -> None:
+        # claim the waiter under the lock: set_coordinator/_reset swap the
+        # list out BEFORE completing futures, so a fut no longer listed is
+        # theirs to complete — failing it here would turn a coordinator
+        # that arrived exactly at the deadline into a spurious timeout
+        with self._barrier:
+            claimed = fut in self._coord_waiters
+            if claimed:
+                self._coord_waiters.remove(fut)
+        if not claimed:
+            return
+        try:
+            fut.set_exception(TimeoutError(
+                "coordinator address never arrived "
+                "(rank 0 dead before jax.distributed?)"))
+        except InvalidStateError:
+            pass  # completed while we were between lock and here
 
     def _wait_barrier(self, table: dict, phase: str) -> None:
         deadline = time.time() + self.timeout
@@ -179,6 +224,10 @@ class SPMDJob:
     def start(self) -> "SPMDJob":
         if self._started:
             raise RuntimeError(f"SPMD job {self.job_name} already started")
+        # a restarted gang's rank 0 binds a FRESH coordinator port; serving
+        # the previous gang's address would wedge every other rank's
+        # jax.distributed.initialize against a dead socket
+        self._coordinator = None
         self._reserve_placement()
         self._server = RpcServer(MethodDispatcher(_DriverService(self)),
                                  max_concurrency=max(4, self.world_size),
@@ -352,6 +401,15 @@ class SPMDJob:
     def _reset(self) -> None:
         """Full teardown so the same job object can start again
         (parity: mpi_job.py:344-395 ``_reset``)."""
+        with self._barrier:
+            waiters, self._coord_waiters = self._coord_waiters, []
+        for fut in waiters:  # a parked get_coordinator must not outlive us
+            try:
+                fut.set_exception(TimeoutError(
+                    f"SPMD job {self.job_name} stopped before rank 0 "
+                    "reported a coordinator"))
+            except InvalidStateError:
+                pass  # its timeout timer already failed it
         for stub in self._stubs.values():
             stub.close()
         self._stubs.clear()

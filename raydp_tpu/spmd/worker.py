@@ -23,6 +23,7 @@ import traceback
 
 import cloudpickle
 
+from raydp_tpu import knobs
 from raydp_tpu.log import init_logging
 from raydp_tpu.runtime.rpc import RpcServer, connect_with_retry
 from raydp_tpu.spmd.job import (
@@ -77,12 +78,12 @@ def main() -> None:
     # file), so a hung collective can be diagnosed from outside
     faulthandler.register(signal.SIGUSR1, all_threads=True)
 
-    job_id = os.environ[ENV_JOB_ID]
-    driver_url = os.environ[ENV_DRIVER]
-    rank = int(os.environ[ENV_RANK])
-    world_size = int(os.environ[ENV_WORLD])
+    job_id = str(knobs.require(ENV_JOB_ID))
+    driver_url = str(knobs.require(ENV_DRIVER))
+    rank = int(knobs.require(ENV_RANK))
+    world_size = int(knobs.require(ENV_WORLD))
 
-    init_logging(f"spmd-{job_id}-r{rank}", os.environ.get("RDT_LOG_LEVEL", "INFO"),
+    init_logging(f"spmd-{job_id}-r{rank}", str(knobs.get("RDT_LOG_LEVEL")),
                  None, job_id)
 
     d_host, d_port = driver_url.rsplit(":", 1)
@@ -90,14 +91,14 @@ def main() -> None:
     reply = driver.call("register_worker", rank, os.getpid())
     assert reply["world_size"] == world_size
 
-    if os.environ.get(ENV_JAX_DIST) == "1":
+    if knobs.get(ENV_JAX_DIST):
         import jax
         # interpreter startup may have pre-registered a hardware platform;
         # backend init is lazy, so re-assert the requested platform before
         # the first device touch (same dance as tests/conftest.py)
         if os.environ.get("JAX_PLATFORMS"):
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        coordinator = os.environ.get(ENV_COORDINATOR)  # test/ops override
+        coordinator = knobs.get(ENV_COORDINATOR)  # test/ops override
         if not coordinator:
             if rank == 0:
                 # rank 0 picks the port on its own routable interface moments

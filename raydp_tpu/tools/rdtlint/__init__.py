@@ -1,0 +1,57 @@
+"""rdtlint — project-native static analysis for raydp_tpu.
+
+Four rule families, each encoding an invariant this repo's reviews kept
+re-finding by hand (see doc/dev_lint.md for the full reference and the
+annotation conventions):
+
+- ``dispatcher-blocking`` — blocking primitives must not be reachable from
+  RPC dispatcher entry points ("waits never park head dispatchers").
+- ``lock-discipline`` — ``# guarded-by: _lock`` attributes are accessed
+  under their lock.
+- ``knob-registry`` — every ``RDT_*`` knob is declared in
+  ``raydp_tpu/knobs.py``, read through it (never cached at import time when
+  per-action), and the doc tables are generated from it.
+- ``fault-site-sync`` — fault-injection sites agree across code,
+  ``faults.KNOWN_SITES``, ``doc/fault_tolerance.md``, and test specs.
+
+Run it::
+
+    python -m raydp_tpu.tools.rdtlint raydp_tpu/
+
+Exit code 0 = no unsuppressed violations. Deliberate exceptions carry an
+inline ``# rdtlint: allow[<rule>] <reason>`` (the reason is mandatory).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from raydp_tpu.tools.rdtlint import (
+    rule_dispatcher, rule_faults, rule_knobs, rule_locks)
+from raydp_tpu.tools.rdtlint.core import (
+    RULES, Project, Report, Violation, apply_suppressions)
+
+_RULE_CHECKS = {
+    "dispatcher-blocking": rule_dispatcher.check,
+    "lock-discipline": rule_locks.check,
+    "knob-registry": rule_knobs.check,
+    "fault-site-sync": rule_faults.check,
+}
+
+
+def run(paths: Iterable[str], root: Optional[str] = None,
+        rules: Optional[Iterable[str]] = None) -> Report:
+    """Lint ``paths`` and return the :class:`Report` (violations carry their
+    suppression state; callers gate on ``report.unsuppressed``)."""
+    project = Project.load(list(paths), root=root)
+    violations: List[Violation] = list(project.errors)
+    for name in (rules if rules is not None else RULES):
+        violations.extend(_RULE_CHECKS[name](project))
+    # rule 4 scans tests/benchmarks lazily; load order guarantees their
+    # suppressions are visible here
+    apply_suppressions(project, violations)
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return Report(violations, files_linted=len(project.files))
+
+
+__all__ = ["run", "Report", "Violation", "Project", "RULES"]

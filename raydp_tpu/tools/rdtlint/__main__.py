@@ -1,0 +1,52 @@
+"""CLI: ``python -m raydp_tpu.tools.rdtlint [paths...]``.
+
+Pure AST pass — no runtime spin-up; safe to run anywhere the sources parse.
+Exit codes: 0 = clean (suppressed-only), 1 = unsuppressed violations,
+2 = usage/parse failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from raydp_tpu.tools.rdtlint import RULES, run
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m raydp_tpu.tools.rdtlint",
+        description="project-native static analysis (doc/dev_lint.md)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint (default: the raydp_tpu "
+                         "package next to this tool)")
+    ap.add_argument("--rule", action="append", choices=RULES, default=None,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--root", default=None,
+                    help="repo root for cross-checks (default: nearest "
+                         "pyproject.toml above the first path)")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed violations")
+    args = ap.parse_args(argv)
+
+    paths = args.paths
+    if not paths:
+        here = os.path.dirname(os.path.abspath(__file__))
+        paths = [os.path.dirname(os.path.dirname(here))]  # the package dir
+    try:
+        report = run(paths, root=args.root, rules=args.rule)
+    except FileNotFoundError as e:
+        print(f"rdtlint: {e}", file=sys.stderr)
+        return 2
+    if report.files_linted == 0:
+        # an empty run is a misconfiguration, never a clean tree
+        print(f"rdtlint: no Python files under {' '.join(paths)}",
+              file=sys.stderr)
+        return 2
+    print(report.render(show_suppressed=args.show_suppressed))
+    return 1 if report.unsuppressed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,45 @@
+"""Project-native configuration of the rdtlint rules.
+
+rdtlint is not a generic linter: these names encode *this* repo's runtime
+architecture. Keep them in sync with the modules they describe (the
+``fault-site-sync`` and ``knob-registry`` rules are self-syncing; this file
+covers what cannot be derived from the AST alone).
+"""
+
+#: classes whose PUBLIC methods run on a bounded RPC dispatcher thread pool
+#: (``RpcServer(MethodDispatcher(...))`` targets, actor dispatch targets, and
+#: the store server the head proxies into). The dispatcher-blocking rule also
+#: auto-detects ``MethodDispatcher(Cls(...))`` / ``RpcServer(Cls(...))``
+#: constructions; this list covers targets built through intermediate
+#: variables the AST pass cannot follow.
+ENTRY_CLASS_NAMES = frozenset({
+    "HeadService",        # runtime/head.py — the head's RPC surface
+    "NodeAgentService",   # runtime/node_agent.py
+    "ObjectStoreServer",  # runtime/object_store.py — head dispatchers proxy
+                          # store_* calls straight into it
+    "ShuffleStreamLedger",  # runtime/object_store.py — ditto, stream_* calls
+    "EtlExecutor",        # etl/executor.py — actor dispatch target
+    "EtlMaster",          # etl/master.py — actor dispatch target
+    "_DriverService",     # spmd/job.py
+    "_WorkerService",     # spmd/worker.py
+})
+
+#: attribute names whose *call* is treated as a blocking primitive by the
+#: dispatcher-blocking rule (receiver heuristics in callgraph.py narrow the
+#: noisy ones: ``.join`` skips str/os.path joins, ``.get`` only fires on
+#: store/queue-shaped receivers)
+BLOCKING_ATTRS = frozenset({
+    "sleep",   # time.sleep — parks the thread outright
+    "result",  # concurrent.futures.Future.result — may wait on work that
+               # needs THIS dispatcher pool to complete (the classic
+               # self-deadlock)
+    "call",    # RpcClient.call — a synchronous round trip; a head handler
+               # calling back into a peer can deadlock on pool exhaustion
+    "wait",    # Event/Condition wait, long-polls
+    "join",    # Thread.join
+})
+
+#: receiver names (or suffixes) for which a ``.get(...)`` call is treated as
+#: a blocking store/queue read rather than a dict lookup
+STORE_GET_RECEIVERS = frozenset({"client", "store", "queue", "q"})
+STORE_GET_SUFFIXES = ("_client", "_store", "_queue")

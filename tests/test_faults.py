@@ -23,13 +23,18 @@ def test_parse_spec_grammar(tmp_path):
     with pytest.raises(ValueError):
         faults.parse_spec("just-a-site")
     with pytest.raises(ValueError):
-        faults.parse_spec("site:raise:bogus_option=1")
+        faults.parse_spec("store.get:raise:bogus_option=1")
     with pytest.raises(ValueError):
-        faults.parse_spec("site:raise:notkeyvalue")
+        faults.parse_spec("store.get:raise:notkeyvalue")
     # a typo'd or misplaced action must fail the parse, not silently arm a
     # rule that claims its once-sentinel while injecting nothing
     with pytest.raises(ValueError):
         faults.parse_spec("executor.run_task:dorp:nth=1")
+    # same loud-failure contract for a typo'd SITE: the env spec names an
+    # injection point that exists nowhere in code (faults.KNOWN_SITES)
+    with pytest.raises(ValueError):
+        # rdtlint: allow[fault-site-sync] deliberately typo'd site
+        faults.parse_spec("executor.run_tsak:crash:nth=1")
     with pytest.raises(ValueError):
         faults.parse_spec("rpc.call:drop:nth=1")
     with pytest.raises(ValueError):
@@ -62,7 +67,8 @@ def test_probability_schedule_is_seed_deterministic():
 def test_stacked_identical_p_rules_draw_independent_streams():
     """Two spec rules identical in (seed, site, action) must not mirror each
     other's p= draws — the registry index feeds the PRNG stream."""
-    a, b = faults.parse_spec("s:raise:p=0.5;s:raise:p=0.5", default_seed=3)
+    a, b = faults.parse_spec("store.get:raise:p=0.5;store.get:raise:p=0.5",
+                             default_seed=3)
     pattern_a = [a.should_fire("k") for _ in range(64)]
     pattern_b = [b.should_fire("k") for _ in range(64)]
     assert pattern_a != pattern_b

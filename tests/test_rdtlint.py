@@ -1,0 +1,607 @@
+"""rdtlint: the tier-1 zero-violation fence over the real tree, plus
+fixture-based units proving each rule fires on the bad shape and stays quiet
+on the fixed one — including reproductions of the two historical deadlocks
+(PR 3's read-loop-blocking late-result callback, PR 7's streaming
+self-deadlock) and the two acceptance regressions (removing the
+``DeferredReply`` hand-off from a streaming ``run_task``; removing the
+``_patch_lock`` guard from an ``_ActionTemps``-shaped class)."""
+
+import os
+import textwrap
+
+import pytest
+
+from raydp_tpu.tools import rdtlint
+from raydp_tpu.tools.rdtlint import run
+from raydp_tpu.tools.rdtlint.__main__ import main as rdtlint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "raydp_tpu")
+
+
+# ---------------------------------------------------------------------------
+# the fence: the whole package must be clean (suppressed-only)
+# ---------------------------------------------------------------------------
+
+def test_tree_is_clean():
+    report = run([PKG], root=REPO)
+    assert not report.unsuppressed, "\n" + report.render()
+    # the suppression inventory is part of the reviewed surface: additions
+    # must come through this file so the reason gets a second pair of eyes
+    assert len(report.suppressed) <= 12, "\n" + report.render(True)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    assert rdtlint_main([PKG, "--root", REPO]) == 0
+    bad = _repo(tmp_path, {"pkg/m.py": "import os\n"
+                           "V = os.environ.get('RDT_X')\n"})
+    assert rdtlint_main([str(bad / "pkg"), "--root", str(bad)]) == 1
+    # the fence must fail LOUDLY on a misconfigured path — a typo'd CI leg
+    # reporting a clean tree would green-light anything forever
+    assert rdtlint_main([str(tmp_path / "nonexistent")]) == 2
+    (tmp_path / "empty").mkdir()
+    assert rdtlint_main([str(tmp_path / "empty")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# fixture plumbing
+# ---------------------------------------------------------------------------
+
+def _repo(tmp_path, files):
+    """A throwaway repo: pyproject.toml marks the root; ``files`` maps
+    relative paths to (dedented) contents."""
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(content))
+    return tmp_path
+
+
+def _lint(tmp_path, files, rules=None):
+    root = _repo(tmp_path, files)
+    return run([str(root / "pkg")], root=str(root), rules=rules)
+
+
+def _msgs(report, rule=None):
+    return [v.message for v in report.unsuppressed
+            if rule is None or v.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# rule 1: dispatcher-blocking
+# ---------------------------------------------------------------------------
+
+# the PR 7 shape: a streaming run_task that waits for seal notifications.
+# GOOD = the shipped design (dedicated thread + DeferredReply); BAD = the
+# acceptance regression (hand-off removed, the dispatcher thread waits)
+_STREAM_COMMON = """
+    import threading
+    from concurrent.futures import Future
+
+
+    class DeferredReply:
+        def __init__(self, future):
+            self.future = future
+
+
+    class MethodDispatcher:
+        def __init__(self, target):
+            self._t = target
+
+
+    class StreamExecutor:
+        def __init__(self):
+            self._sealed = threading.Event()
+
+        def _stream_wait(self, task):
+            # the consumed-stream wait: blocks until every map seals — maps
+            # that may be queued BEHIND this very dispatcher thread
+            self._sealed.wait()
+            return task
+
+        def _run_obj(self, task):
+            return {"rows": 1}
+"""
+
+_STREAM_BAD = _STREAM_COMMON + """
+        def run_task(self, task):
+            if getattr(task, "streaming", False):
+                return self._stream_wait(task)  # parks the dispatcher
+            return self._run_obj(task)
+
+
+    _server = MethodDispatcher(StreamExecutor())
+"""
+
+_STREAM_GOOD = _STREAM_COMMON + """
+        def run_task(self, task):
+            if getattr(task, "streaming", False):
+                fut = Future()
+
+                def _run():
+                    fut.set_result(self._stream_wait(task))
+
+                threading.Thread(target=_run, daemon=True).start()
+                return DeferredReply(fut)
+            return self._run_obj(task)
+
+
+    _server = MethodDispatcher(StreamExecutor())
+"""
+
+
+def test_dispatcher_rule_catches_streaming_self_deadlock(tmp_path):
+    report = _lint(tmp_path, {"pkg/ex.py": _STREAM_BAD},
+                   rules=["dispatcher-blocking"])
+    msgs = _msgs(report, "dispatcher-blocking")
+    assert len(msgs) == 1 and "wait" in msgs[0] \
+        and "run_task -> _stream_wait" in msgs[0]
+
+
+def test_dispatcher_rule_accepts_deferred_reply_handoff(tmp_path):
+    report = _lint(tmp_path, {"pkg/ex.py": _STREAM_GOOD},
+                   rules=["dispatcher-blocking"])
+    assert _msgs(report, "dispatcher-blocking") == []
+
+
+# the PR 3 shape: a Future done-callback fires on the RPC connection's READ
+# LOOP and synchronously calls back over that same connection
+_CALLBACK_COMMON = """
+    import threading
+
+
+    class Pool:
+        def __init__(self, client):
+            self.client = client
+
+        def _free_sync(self, fut):
+            self.client.call("drop_blocks", fut)
+
+        def watch(self, fut):
+            fut.add_done_callback(self._free_late)
+"""
+
+_CALLBACK_BAD = _CALLBACK_COMMON + """
+        def _free_late(self, fut):
+            # blocks the only thread able to deliver its own response
+            self._free_sync(fut)
+"""
+
+_CALLBACK_GOOD = _CALLBACK_COMMON + """
+        def _free_late(self, fut):
+            threading.Thread(target=self._free_sync, args=(fut,),
+                             daemon=True).start()
+"""
+
+
+def test_dispatcher_rule_catches_read_loop_blocking_callback(tmp_path):
+    report = _lint(tmp_path, {"pkg/pool.py": _CALLBACK_BAD},
+                   rules=["dispatcher-blocking"])
+    msgs = _msgs(report, "dispatcher-blocking")
+    assert len(msgs) == 1 and "RpcClient.call" in msgs[0] \
+        and "completion callback" in msgs[0]
+
+
+def test_dispatcher_rule_accepts_thread_handoff_callback(tmp_path):
+    report = _lint(tmp_path, {"pkg/pool.py": _CALLBACK_GOOD},
+                   rules=["dispatcher-blocking"])
+    assert _msgs(report, "dispatcher-blocking") == []
+
+
+def test_dispatcher_rule_heuristics(tmp_path):
+    # str.join / os.path.join / dict.get never count as blocking; sleep,
+    # thread join, and store get do — and a reasoned allow suppresses
+    src = """
+    import os
+    import time
+
+
+    class MethodDispatcher:
+        def __init__(self, t):
+            pass
+
+
+    class Svc:
+        def fine(self, parts, d):
+            x = ", ".join(parts)
+            y = os.path.join("a", "b")
+            return d.get("k"), x, y
+
+        def slow(self):
+            time.sleep(1.0)  # rdtlint: allow[dispatcher-blocking] test stub
+
+        def joins(self, t):
+            t.join()
+
+        def reads(self, client):
+            return client.get("oid")
+
+
+    _s = MethodDispatcher(Svc())
+    """
+    report = _lint(tmp_path, {"pkg/svc.py": src},
+                   rules=["dispatcher-blocking"])
+    msgs = _msgs(report, "dispatcher-blocking")
+    assert len(msgs) == 2
+    assert any("thread join" in m for m in msgs)
+    assert any("store/queue get" in m for m in msgs)
+    assert len(report.suppressed) == 1  # the reasoned sleep
+
+
+def test_dispatcher_rule_follows_annotated_attribute(tmp_path):
+    # the self._job._wait(...) shape: resolution through an __init__
+    # parameter annotation (how the SPMD coordinator deadlock was found)
+    src = """
+    class Job:
+        def wait_thing(self, t):
+            self._cond.wait(t)
+
+
+    class Service:
+        def __init__(self, job: "Job"):
+            self._job = job
+
+        def get_thing(self, t):
+            return self._job.wait_thing(t)
+
+
+    class MethodDispatcher:
+        def __init__(self, t):
+            pass
+
+
+    _s = MethodDispatcher(Service(None))
+    """
+    report = _lint(tmp_path, {"pkg/svc.py": src},
+                   rules=["dispatcher-blocking"])
+    msgs = _msgs(report, "dispatcher-blocking")
+    assert len(msgs) == 1 and "get_thing -> wait_thing" in msgs[0]
+
+
+# ---------------------------------------------------------------------------
+# rule 2: lock-discipline
+# ---------------------------------------------------------------------------
+
+# the _ActionTemps shape: ref_patches guarded by _patch_lock. BAD = the
+# acceptance regression (lock removed from apply_patches)
+_TEMPS = """
+    import threading
+
+
+    class Temps:
+        def __init__(self):
+            self.ref_patches = {}  # guarded-by: _patch_lock
+            self._patch_lock = threading.Lock()
+
+        def apply_patches(self, mapping):
+            {body}
+"""
+
+_TEMPS_GOOD_BODY = """\
+            with self._patch_lock:
+                for k, v in mapping.items():
+                    self.ref_patches[k] = v
+"""
+
+_TEMPS_BAD_BODY = """\
+            for k, v in mapping.items():
+                self.ref_patches[k] = v
+"""
+
+
+def test_lock_rule_catches_unguarded_patch_map(tmp_path):
+    src = _TEMPS.replace("            {body}", _TEMPS_BAD_BODY)
+    report = _lint(tmp_path, {"pkg/temps.py": src},
+                   rules=["lock-discipline"])
+    msgs = _msgs(report, "lock-discipline")
+    assert msgs and "ref_patches" in msgs[0] and "_patch_lock" in msgs[0]
+
+
+def test_lock_rule_accepts_guarded_patch_map(tmp_path):
+    src = _TEMPS.replace("            {body}", _TEMPS_GOOD_BODY)
+    report = _lint(tmp_path, {"pkg/temps.py": src},
+                   rules=["lock-discipline"])
+    assert _msgs(report, "lock-discipline") == []
+
+
+def test_lock_rule_method_level_annotation_and_init_exemption(tmp_path):
+    src = """
+    import threading
+
+
+    class Ledger:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._stages = {}  # guarded-by: _lock
+            self._stages["boot"] = 1  # __init__ is exempt
+
+        def _resp_locked(self, key):  # guarded-by: _lock
+            return self._stages.get(key)
+
+        def publish(self, key):
+            with self._lock:
+                self._stages[key] = 1
+                return self._resp_locked(key)
+
+        def peek(self, key):
+            # rdtlint: allow[lock-discipline] racy read tolerated in test
+            return self._stages.get(key)
+
+        def broken(self, key):
+            return self._stages.get(key)
+    """
+    report = _lint(tmp_path, {"pkg/ledger.py": src},
+                   rules=["lock-discipline"])
+    msgs = _msgs(report, "lock-discipline")
+    assert len(msgs) == 1 and "broken()" in msgs[0]
+    assert len(report.suppressed) == 1
+
+
+def test_lock_rule_registers_annotation_on_continuation_line(tmp_path):
+    # the _StreamStageRec.seals shape: a wrapped initializer carrying the
+    # guard comment on its continuation line must still register
+    src = """
+    import threading
+
+
+    class Rec:
+        def __init__(self, n):
+            self._lock = threading.Lock()
+            self.seals = \\
+                [None] * n  # guarded-by: _lock
+
+        def bad(self, i):
+            return self.seals[i]
+
+        def good(self, i):
+            with self._lock:
+                return self.seals[i]
+    """
+    report = _lint(tmp_path, {"pkg/rec.py": src}, rules=["lock-discipline"])
+    msgs = _msgs(report, "lock-discipline")
+    assert len(msgs) == 1 and "bad()" in msgs[0] and "seals" in msgs[0]
+
+
+def test_lock_rule_trailing_comment_does_not_leak_to_next_line(tmp_path):
+    src = """
+    import threading
+
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._guarded = {}  # guarded-by: _lock
+            self._free = 0
+
+        def touch(self):
+            self._free += 1  # NOT guarded: must not inherit the annotation
+    """
+    report = _lint(tmp_path, {"pkg/c.py": src}, rules=["lock-discipline"])
+    assert _msgs(report, "lock-discipline") == []
+
+
+# ---------------------------------------------------------------------------
+# rule 3: knob-registry
+# ---------------------------------------------------------------------------
+
+_FIXTURE_KNOBS = """
+    from dataclasses import dataclass
+
+
+    @dataclass
+    class Knob:
+        name: str
+        scope: str
+
+
+    KNOBS = {
+        "RDT_GOOD": Knob("RDT_GOOD", "per-action"),
+        "RDT_BOOT": Knob("RDT_BOOT", "process-start"),
+    }
+    DOC_TABLES = ()
+
+
+    def table_markers(category):
+        return ("<!-- b -->", "<!-- e -->")
+
+
+    def render_block(category):
+        return ""
+
+
+    def get(name):
+        return None
+"""
+
+
+def test_knob_rule_flags_direct_reads_and_resolves_constants(tmp_path):
+    src = """
+    import os
+
+    ENV_NAME = "RDT_VIA_CONSTANT"
+
+
+    def read():
+        a = os.environ.get("RDT_DIRECT")
+        b = os.environ[ENV_NAME]
+        c = os.getenv("RDT_THIRD", "1")
+        os.environ["RDT_WRITE"] = "1"  # writes are fine
+        return a, b, c
+    """
+    report = _lint(tmp_path, {"pkg/m.py": src}, rules=["knob-registry"])
+    msgs = _msgs(report, "knob-registry")
+    assert len(msgs) == 3
+    assert any("RDT_VIA_CONSTANT" in m for m in msgs)
+    assert not any("RDT_WRITE" in m for m in msgs)
+
+
+def test_knob_rule_registry_membership_and_import_time_cache(tmp_path):
+    src = """
+    from pkg import knobs
+
+    CACHED = knobs.get("RDT_GOOD")           # per-action at import: flagged
+    BOOT = knobs.get("RDT_BOOT")             # process-start at import: fine
+
+
+    def f(x=knobs.get("RDT_GOOD")):          # defaults run at def time
+        return x
+
+
+    def g():
+        ok = knobs.get("RDT_GOOD")           # call-time read: fine
+        return ok, knobs.get("RDT_MISSING")  # unregistered: flagged
+    """
+    report = _lint(tmp_path, {"pkg/knobs.py": _FIXTURE_KNOBS,
+                              "pkg/m.py": src}, rules=["knob-registry"])
+    msgs = _msgs(report, "knob-registry")
+    import_time = [m for m in msgs if "import time" in m]
+    assert len(import_time) == 2
+    assert any("RDT_MISSING" in m and "not declared" in m for m in msgs)
+    assert not any("RDT_BOOT" in m and "import time" in m for m in msgs)
+
+
+def test_knob_rule_flags_dead_registry_entries(tmp_path):
+    report = _lint(tmp_path, {
+        "pkg/knobs.py": _FIXTURE_KNOBS,
+        "pkg/m.py": "from pkg import knobs\n\n\n"
+                    "def f():\n    return knobs.get('RDT_GOOD')\n"},
+        rules=["knob-registry"])
+    msgs = _msgs(report, "knob-registry")
+    assert any("RDT_BOOT" in m and "no linted code references" in m
+               for m in msgs)
+
+
+def test_real_registry_docs_and_defaults():
+    from raydp_tpu import knobs
+
+    # the generated tables cover every knob, and get() honors defaults,
+    # parsing, and the empty-string-is-unset contract
+    table = knobs.generate_table()
+    for name in knobs.KNOBS:
+        assert f"`{name}`" in table
+    assert knobs.get("RDT_LINEAGE_ROUNDS") == 4
+    old = os.environ.pop("RDT_LINEAGE_ROUNDS", None)
+    try:
+        os.environ["RDT_LINEAGE_ROUNDS"] = ""
+        assert knobs.get("RDT_LINEAGE_ROUNDS") == 4
+        os.environ["RDT_LINEAGE_ROUNDS"] = "2.0"
+        assert knobs.get("RDT_LINEAGE_ROUNDS") == 2
+        os.environ["RDT_ETL_AQE"] = "off"
+        assert knobs.get("RDT_ETL_AQE") is False
+    finally:
+        os.environ.pop("RDT_ETL_AQE", None)
+        if old is None:
+            os.environ.pop("RDT_LINEAGE_ROUNDS", None)
+        else:
+            os.environ["RDT_LINEAGE_ROUNDS"] = old
+    with pytest.raises(KeyError):
+        knobs.get("RDT_NOT_A_KNOB")
+    with pytest.raises(KeyError):
+        knobs.require("RDT_SPMD_JOB_ID")
+
+
+# ---------------------------------------------------------------------------
+# rule 4: fault-site-sync
+# ---------------------------------------------------------------------------
+
+_FIXTURE_FAULTS = """
+    KNOWN_SITES = frozenset((
+        "good.site",
+        "stale.site",
+    ))
+
+
+    def check(site, key=""):
+        return None
+"""
+
+
+def test_fault_rule_cross_checks_code_registry_tests_and_docs(tmp_path):
+    root = _repo(tmp_path, {
+        "pkg/faults.py": _FIXTURE_FAULTS,
+        "pkg/m.py": """
+            from pkg import faults
+
+
+            def f():
+                faults.check("good.site", key="k")
+                faults.check("rogue.site", key="k")
+            """,
+        "tests/test_x.py": """
+            SPEC = "good.site:drop:nth=1"
+            GHOST = "ghost.site:crash:once=/tmp/s"
+            """,
+        "doc/fault_tolerance.md": """
+            | Site | Fires at | Actions |
+            | --- | --- | --- |
+            | `good.site` | somewhere | `drop` |
+            | `phantom.site` | nowhere | `crash` |
+            """,
+    })
+    report = run([str(root / "pkg")], root=str(root),
+                 rules=["fault-site-sync"])
+    msgs = _msgs(report, "fault-site-sync")
+    assert any("'rogue.site'" in m and "KNOWN_SITES" in m for m in msgs)
+    assert any("'stale.site'" in m and "stale registry" in m for m in msgs)
+    assert any("'ghost.site'" in m and "inject nothing" in m for m in msgs)
+    assert any("'phantom.site'" in m for m in msgs)
+    # the documented + armed + registered site is never flagged
+    assert not any("'good.site'" in m for m in msgs)
+
+
+def test_fault_rule_quiet_on_consistent_fixture(tmp_path):
+    root = _repo(tmp_path, {
+        "pkg/faults.py": """
+            KNOWN_SITES = frozenset(("only.site",))
+
+
+            def check(site, key=""):
+                return None
+            """,
+        "pkg/m.py": """
+            from pkg import faults
+
+
+            def f():
+                faults.check("only.site")
+            """,
+        "tests/test_x.py": 'S = "only.site:delay:ms=5"\n',
+        "doc/fault_tolerance.md":
+            "| Site | Fires at | Actions |\n| --- | --- | --- |\n"
+            "| `only.site` | f | `delay` |\n",
+    })
+    report = run([str(root / "pkg")], root=str(root),
+                 rules=["fault-site-sync"])
+    assert _msgs(report, "fault-site-sync") == []
+
+
+def test_real_parse_spec_sites_match_lint_registry():
+    # the lint's view of KNOWN_SITES and the runtime's must be the same
+    # object: a drifted copy would let the fence and the parser disagree
+    from raydp_tpu import faults
+    from raydp_tpu.tools.rdtlint.core import Project
+    from raydp_tpu.tools.rdtlint.rule_faults import _code_sites, _known_sites
+
+    project = Project.load([PKG], root=REPO)
+    declared, _line = _known_sites(project.find_file("faults.py"))
+    assert declared == set(faults.KNOWN_SITES)
+    assert set(_code_sites(project)) == set(faults.KNOWN_SITES)
+
+
+# ---------------------------------------------------------------------------
+# suppression mechanics
+# ---------------------------------------------------------------------------
+
+def test_suppression_requires_reason(tmp_path):
+    src = """
+    import os
+
+    A = os.environ.get("RDT_A")  # rdtlint: allow[knob-registry]
+    # rdtlint: allow[knob-registry] reasoned: fixture exercising suppression
+    B = os.environ.get("RDT_B")
+    """
+    report = _lint(tmp_path, {"pkg/m.py": src}, rules=["knob-registry"])
+    msgs = _msgs(report, "knob-registry")
+    assert len(msgs) == 1 and "RDT_A" in msgs[0]
+    assert len(report.suppressed) == 1
