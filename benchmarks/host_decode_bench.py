@@ -150,6 +150,7 @@ def overlap_run(rows=400_000, batch=8192, chain=4, hidden=256, layers=2,
         "overlap_hidden_s": round(sum_phases - wall, 3),
         "overlapped": bool(wall < sum_phases),
     }
+    # rdtlint: allow[knob-registry] bench output-path plumbing, not a runtime knob
     path = out_path or os.environ.get(
         "RDT_HOST_DECODE_DETAIL_PATH",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),

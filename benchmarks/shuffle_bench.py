@@ -472,6 +472,7 @@ def main_pipeline(smoke):
     default_path = ("/tmp/PIPELINE_SMOKE.json" if smoke else
                     os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "PIPELINE.json"))
+    # rdtlint: allow[knob-registry] bench output-path plumbing, not a runtime knob
     out_path = os.environ.get("RDT_PIPELINE_PATH", default_path)
     record = {
         "metric": "etl_shuffle_pipeline",
@@ -503,6 +504,7 @@ def main_aqe(smoke):
     default_path = ("/tmp/AQE_SMOKE.json" if smoke else
                     os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "AQE.json"))
+    # rdtlint: allow[knob-registry] bench output-path plumbing, not a runtime knob
     out_path = os.environ.get("RDT_AQE_PATH", default_path)
     rows = 4_000 if smoke else 400_000
     parts = 4 if smoke else 8
@@ -552,6 +554,7 @@ def main_straggler(smoke):
     default_path = ("/tmp/STRAGGLER_SMOKE.json" if smoke else
                     os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "STRAGGLER.json"))
+    # rdtlint: allow[knob-registry] bench output-path plumbing, not a runtime knob
     out_path = os.environ.get("RDT_STRAGGLER_PATH", default_path)
     record = {
         "metric": "etl_straggler_speculation",
@@ -586,6 +589,7 @@ def main():
     default_path = ("/tmp/SHUFFLE_BYTES_SMOKE.json" if smoke else
                     os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "SHUFFLE_BYTES.json"))
+    # rdtlint: allow[knob-registry] bench output-path plumbing, not a runtime knob
     out_path = os.environ.get("RDT_SHUFFLE_BYTES_PATH", default_path)
 
     import raydp_tpu

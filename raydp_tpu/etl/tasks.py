@@ -150,7 +150,7 @@ def concat_or_empty(tables: List[pa.Table],
 
 
 @dataclass
-class ArrowRefSource(Step):
+class ArrowRefSource(Step):  # carries-refs: refs
     """Concatenate Arrow tables from object-store refs (zero-copy reads)."""
 
     refs: List[ObjectRef]
@@ -163,7 +163,7 @@ class ArrowRefSource(Step):
 
 
 @dataclass
-class RangeRefSource(Step):
+class RangeRefSource(Step):  # carries-refs: parts
     """Byte-range reads of store blobs: ``(ref, offset, size)`` triples, each
     range an independent Arrow IPC stream — the reduce-side reader of the
     consolidated shuffle path (a map task's B buckets live back-to-back in
@@ -317,7 +317,7 @@ class StreamingRangeSource(Step):
 
 
 @dataclass
-class SlicedRefSource(Step):
+class SlicedRefSource(Step):  # carries-refs: parts
     """Row-range slices of store refs: ``(ref, offset, length)`` triples.
 
     Used by the balanced sharding path (``divide_blocks``) where a rank takes only
@@ -336,7 +336,7 @@ class SlicedRefSource(Step):
 
 
 @dataclass
-class CachedSource(Step):
+class CachedSource(Step):  # carries-refs: recover
     """Executor-local cached block, with a recovery recipe on miss.
 
     Parity: BlockManager read in ``getRDDPartition`` with recache-then-retry on
@@ -835,7 +835,7 @@ class GroupAggPartialMergeStep(Step):
 
 
 @dataclass
-class HashJoinStep(Step):
+class HashJoinStep(Step):  # carries-refs: right_refs, right_parts, right_stream
     """Join the incoming (left bucket) table against the right bucket refs.
 
     ``right_parts`` (byte-range triples) carries the right side when it was
@@ -877,7 +877,7 @@ BROADCAST_LEFT_JOIN_TYPES = frozenset(
 
 
 @dataclass
-class BroadcastJoinStep(Step):
+class BroadcastJoinStep(Step):  # carries-refs: parts
     """Broadcast-hash join: stream this task's partition against an
     executor-local hash table of the (small) broadcast side.
 

@@ -1101,6 +1101,20 @@ class ObjectRef:
         return hash(self.id)
 
 
+#: RemoteError.exc_type names meaning "the blob is unreachable where the
+#: table said it was" — translated to :class:`ObjectLostError` so the reader
+#: falls into lineage recovery instead of burning its retry budget. One
+#: constant, not per-site tuples: the five hand-copied copies these replace
+#: are exactly the drift rdtlint's ``exc-contract`` rule now guards.
+_REMOTE_LOST_EXC_TYPES = ("KeyError", "ObjectLostError", "FileNotFoundError")
+
+#: the subset meaning "possibly just a stale location" (spill/fault-in moved
+#: the payload between lookup and read): worth ONE fresh-lookup retry before
+#: escalating to a typed loss. KeyError joins FileNotFoundError here because
+#: a peer arena that re-homed a segment reports the miss as KeyError.
+_REMOTE_STALE_EXC_TYPES = ("FileNotFoundError", "KeyError")
+
+
 class ObjectStoreClient:
     """Per-process client: creates/attaches segments, talks to the table server.
 
@@ -1479,8 +1493,7 @@ class ObjectStoreClient:
             # KeyError (table miss) or FileNotFoundError (segment vanished on
             # the payload host) as a RemoteError; duck-type on exc_type to
             # avoid importing rpc
-            if getattr(e, "exc_type", None) in (
-                    "KeyError", "ObjectLostError", "FileNotFoundError"):
+            if getattr(e, "exc_type", None) in _REMOTE_LOST_EXC_TYPES:
                 self._evict(object_id)
                 raise ObjectLostError(object_id, "blob unreachable: "
                                       f"{getattr(e, 'message', e)}") from e
@@ -1600,9 +1613,8 @@ class ObjectStoreClient:
                     raise ObjectLostError(ref.id,
                                           "not in store table") from e
                 except Exception as e:
-                    if getattr(e, "exc_type", None) in (
-                            "KeyError", "ObjectLostError",
-                            "FileNotFoundError"):
+                    if getattr(e, "exc_type", None) \
+                            in _REMOTE_LOST_EXC_TYPES:
                         raise ObjectLostError(
                             ref.id, "blob unreachable: "
                             f"{getattr(e, 'message', e)}") from e
@@ -1619,8 +1631,7 @@ class ObjectStoreClient:
             # lookup and read): one fresh lookup resolves the new home
             return self._get_ranges_once(parts, fresh=True)
         except Exception as e:
-            if getattr(e, "exc_type", None) in ("FileNotFoundError",
-                                                "KeyError"):
+            if getattr(e, "exc_type", None) in _REMOTE_STALE_EXC_TYPES:
                 return self._get_ranges_once(parts, fresh=True)
             raise
 
@@ -1680,8 +1691,7 @@ class ObjectStoreClient:
                 # KeyError covers a peer arena that no longer hosts the
                 # segment (payload re-homed) — same stale-location shape as
                 # a vanished dedicated segment
-                if getattr(e, "exc_type", None) in ("FileNotFoundError",
-                                                    "KeyError") \
+                if getattr(e, "exc_type", None) in _REMOTE_STALE_EXC_TYPES \
                         or isinstance(e, (FileNotFoundError, KeyError)):
                     if fresh:  # gone even after the fresh lookup: lost
                         for item in items:

@@ -349,6 +349,18 @@ class MethodDispatcher:
             raise AttributeError(f"method {method!r} is not remotely callable")
         fn = getattr(self._target, method, None)
         if fn is None or not callable(fn):
+            # list the real surface: a typo'd call site fails with enough to
+            # fix it, instead of a bare name echoed back through RemoteError.
+            # Introspect the CLASS, not the instance — instance getattr
+            # would execute property getters, and one that raises here would
+            # mask the AttributeError (changing exc_type misroutes the
+            # retry/recovery plane keyed on it)
+            cls = type(self._target)
+            surface = sorted(
+                n for n in dir(cls)
+                if not n.startswith("_")
+                and callable(getattr(cls, n, None)))
             raise AttributeError(
-                f"{type(self._target).__name__} has no remote method {method!r}")
+                f"{cls.__name__} has no remote method "
+                f"{method!r}; remote surface: {', '.join(surface) or '(empty)'}")
         return fn(*args, **kwargs)

@@ -1,6 +1,6 @@
 """rdtlint — project-native static analysis for raydp_tpu.
 
-Four rule families, each encoding an invariant this repo's reviews kept
+Seven rule families, each encoding an invariant this repo's reviews kept
 re-finding by hand (see doc/dev_lint.md for the full reference and the
 annotation conventions):
 
@@ -13,6 +13,14 @@ annotation conventions):
   per-action), and the doc tables are generated from it.
 - ``fault-site-sync`` — fault-injection sites agree across code,
   ``faults.KNOWN_SITES``, ``doc/fault_tolerance.md``, and test specs.
+- ``rpc-surface`` — every literal ``*.call("name", ...)`` resolves to a
+  real remote method with compatible arity, no underscore targets, the
+  head's store proxies are complete, and the generated RPC table is fresh.
+- ``step-registry`` — every ref-carrying ``Step`` class (declared via
+  ``# carries-refs:``) is registered with the lineage-recovery and stream
+  planes; result-ref keys stay in sync with ``engine._result_refs``.
+- ``exc-contract`` — every ``RemoteError.exc_type`` string comparison names
+  a real exception class (repo, builtin, or allowlisted external).
 
 Run it::
 
@@ -27,7 +35,8 @@ from __future__ import annotations
 from typing import Iterable, List, Optional
 
 from raydp_tpu.tools.rdtlint import (
-    rule_dispatcher, rule_faults, rule_knobs, rule_locks)
+    rule_dispatcher, rule_exc, rule_faults, rule_knobs, rule_locks,
+    rule_rpc, rule_steps)
 from raydp_tpu.tools.rdtlint.core import (
     RULES, Project, Report, Violation, apply_suppressions)
 
@@ -36,6 +45,9 @@ _RULE_CHECKS = {
     "lock-discipline": rule_locks.check,
     "knob-registry": rule_knobs.check,
     "fault-site-sync": rule_faults.check,
+    "rpc-surface": rule_rpc.check,
+    "step-registry": rule_steps.check,
+    "exc-contract": rule_exc.check,
 }
 
 

@@ -43,3 +43,100 @@ BLOCKING_ATTRS = frozenset({
 #: a blocking store/queue read rather than a dict lookup
 STORE_GET_RECEIVERS = frozenset({"client", "store", "queue", "q"})
 STORE_GET_SUFFIXES = ("_client", "_store", "_queue")
+
+# ---- rule: rpc-surface ------------------------------------------------------
+
+#: the RPC server surfaces, keyed by the short surface tag the receiver map
+#: below points into. Every ``*.call("name", ...)`` site with a literal method
+#: name resolves against one of these (or their union). ``_WorkerService`` /
+#: ``_ActorServer`` dispatch through a ``__call__(method, ...)`` if-chain
+#: rather than a MethodDispatcher — the surface builder extracts their
+#: ``method == "literal"`` branches.
+RPC_SURFACE_CLASSES = {
+    "head": ("HeadService",),            # runtime/head.py
+    "agent": ("NodeAgentService",),      # runtime/node_agent.py — also the
+                                         # machine-local payload server that
+                                         # ObjectStoreClient._peer dials
+    "store": ("ObjectStoreServer",),     # runtime/object_store.py — reached
+                                         # through the head's store_* proxies
+    "driver": ("_DriverService",),       # spmd/job.py
+    "worker": ("_WorkerService",),       # spmd/worker.py (if-chain handler)
+    "actor": ("_ActorServer", "EtlExecutor", "EtlMaster"),
+}
+
+#: call-site receiver name → surface tag. The name is the receiver variable
+#: (``head.call``), its attribute (``self._head.call``, ``ctx.head.call``),
+#: or the function that PRODUCED it (``self._head_client().call(...)``,
+#: ``self._peer(addr).call(...)``). ``"*"`` means "any surface" — used for
+#: generic handles whose target class is dynamic (ActorHandle, the bootstrap
+#: RpcClient). Receivers not in this map are checked against the union too:
+#: inside this package a literal ``.call("name")`` is always an RPC.
+RPC_RECEIVER_SURFACES = {
+    "head": "head",
+    "_head": "head",
+    "_head_client": "head",
+    "agent": "agent",
+    "_agent": "agent",
+    "_peer": "agent",
+    "driver": "driver",
+    "stub": "worker",
+    "handle": "*",
+    "client": "*",
+}
+
+#: actor-runtime intrinsics served by ``_ActorServer.__call__`` BEFORE the
+#: MethodDispatcher underscore guard — the only legitimate underscore-leading
+#: remote names.
+RPC_INTRINSIC_METHODS = frozenset({
+    "__rdt_ping__", "__rdt_shutdown__", "__rdt_spans__",
+})
+
+#: head proxy naming: ``HeadService.store_<m>`` forwards to
+#: ``ObjectStoreServer.<m>`` (the shape StoreTableProxy relies on)
+RPC_STORE_PROXY_PREFIX = "store_"
+
+#: the client class whose ``self._server.<m>(...)`` calls define which store
+#: methods must stay proxy-reachable from a driver/actor process
+RPC_STORE_CLIENT_CLASS = "ObjectStoreClient"
+RPC_STORE_SERVER_CLASS = "ObjectStoreServer"
+RPC_HEAD_SERVICE_CLASS = "HeadService"
+
+# ---- rule: step-registry ----------------------------------------------------
+
+#: the class whose instances read a shuffle stage through the seal-stream
+#: ledger — it carries no ObjectRefs itself (ranges arrive at run time), but
+#: every task holding one must be routed/resolved through the stream plane
+STEP_STREAM_SOURCE_CLASS = "StreamingRangeSource"
+
+#: handler functions in etl/tasks.py that every REF-carrying (and
+#: nested-task-carrying) step class must be isinstance-handled in
+STEP_REF_HANDLERS = ("_patch_step_refs", "task_input_ids")
+
+#: handler functions in etl/tasks.py that every STREAM-carrying step class
+#: (and nested-task carrier) must be handled in — by isinstance, or by a
+#: ``getattr(step, "<attr>", ...)`` literal on each stream attribute
+STEP_STREAM_HANDLERS = ("stream_sources_of", "resolve_stream_sources")
+
+#: result-dict keys through which a task result may carry store refs; the
+#: executor must write ref-valued results only under these keys and
+#: ``engine._result_refs`` must harvest every one (a key missing there is an
+#: orphan-blob leak on every failed stage)
+STEP_RESULT_REF_KEYS = ("ref", "bucket_refs", "consolidated_ref")
+
+#: engine.py functions that must each isinstance-handle ``_StreamBucket``
+#: (the pipelined stage's bucket placeholder): locality weighting, reduce
+#: source construction, and stream-key tagging
+STEP_STREAM_BUCKET_FUNCS = ("_locality", "_bucket_source", "_bucket_task")
+
+# ---- rule: exc-contract -----------------------------------------------------
+
+#: non-builtin exception names that may legitimately cross the RPC boundary
+#: as ``RemoteError.exc_type`` strings without a class definition in this
+#: repo (the rule validates builtins via the ``builtins`` module and repo
+#: classes from the AST; everything else must be listed here)
+EXC_EXTERNAL_ALLOWLIST = frozenset({
+    # pyarrow: raised by Arrow kernels inside executor task bodies
+    "ArrowException", "ArrowInvalid", "ArrowNotImplementedError",
+    "ArrowKeyError", "ArrowTypeError", "ArrowIndexError",
+    "ArrowMemoryError", "ArrowCapacityError", "ArrowSerializationError",
+})

@@ -15,12 +15,15 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional
 
-#: the four rule families (doc/dev_lint.md)
+#: the rule families (doc/dev_lint.md)
 RULES = (
     "dispatcher-blocking",
     "lock-discipline",
     "knob-registry",
     "fault-site-sync",
+    "rpc-surface",
+    "step-registry",
+    "exc-contract",
 )
 
 _SUPPRESS_RE = re.compile(
@@ -224,6 +227,25 @@ def apply_suppressions(project: Project,
             v.suppressed = True
             v.reason = reason
     return violations
+
+
+def marker_block_violation(rule: str, rel: str, text: str, begin: str,
+                           end: str, expected: str, what: str,
+                           regen_cmd: str) -> Optional[Violation]:
+    """The one drift check shared by every generated-doc fence (knob tables,
+    the RPC-surface table): missing markers or a block differing from
+    ``expected`` is a violation pointing at ``regen_cmd``."""
+    if begin not in text or end not in text:
+        return Violation(
+            rule=rule, path=rel, line=1,
+            message=f"missing generated {what} table markers ({begin})")
+    block = begin + text.split(begin, 1)[1].split(end, 1)[0] + end
+    if block != expected:
+        line = text[:text.index(begin)].count("\n") + 1
+        return Violation(
+            rule=rule, path=rel, line=line,
+            message=f"generated {what} table is stale — run `{regen_cmd}`")
+    return None
 
 
 @dataclass

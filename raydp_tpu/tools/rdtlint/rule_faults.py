@@ -55,6 +55,13 @@ def _code_sites(project: Project) -> Dict[str, Tuple[str, int]]:
     ``faults.apply`` second arg, string literals only)."""
     sites: Dict[str, Tuple[str, int]] = {}
     for src in project.files:
+        rel = src.rel.replace(os.sep, "/")
+        if rel.startswith(("tests/", "benchmarks/")):
+            # tests arm via RDT_FAULTS spec strings (checked below), and
+            # test_faults deliberately probes synthetic sites — neither is a
+            # code arming site, so a combined package+tests lint run must
+            # not register them against KNOWN_SITES / the doc table
+            continue
         aliases = _faults_aliases(src)
         if not aliases:
             continue

@@ -26,7 +26,8 @@ import importlib.util
 import os
 from typing import Dict, List, Optional, Set, Tuple
 
-from raydp_tpu.tools.rdtlint.core import Project, SourceFile, Violation
+from raydp_tpu.tools.rdtlint.core import (
+    Project, SourceFile, Violation, marker_block_violation)
 
 RULE = "knob-registry"
 
@@ -228,17 +229,10 @@ def check(project: Project) -> List[Violation]:
             with open(path, "r", encoding="utf-8") as f:
                 text = f.read()
             begin, end = registry_mod.table_markers(category)
-            if begin not in text or end not in text:
-                out.append(Violation(
-                    rule=RULE, path=rel, line=1,
-                    message=(f"missing generated knob table markers "
-                             f"({begin})")))
-                continue
-            block = begin + text.split(begin, 1)[1].split(end, 1)[0] + end
-            if block != registry_mod.render_block(category):
-                line = text[:text.index(begin)].count("\n") + 1
-                out.append(Violation(
-                    rule=RULE, path=rel, line=line,
-                    message=("generated knob table is stale — run "
-                             "`python -m raydp_tpu.knobs --write-docs`")))
+            v = marker_block_violation(
+                RULE, rel, text, begin, end,
+                registry_mod.render_block(category), "knob",
+                "python -m raydp_tpu.knobs --write-docs")
+            if v is not None:
+                out.append(v)
     return out

@@ -35,6 +35,7 @@ def _run_main(bench, capsys):
     line = capsys.readouterr().out.strip().splitlines()[-1]
     compact = json.loads(line)
     assert len(line) <= 1900, f"stdout line too big for the driver: {len(line)}"
+    # rdtlint: allow[knob-registry] test reads back the path it set above
     with open(os.environ["RDT_BENCH_DETAIL_PATH"]) as fh:
         detail = json.load(fh)
     for key in ("metric", "unit", "platform", "value", "vs_baseline"):

@@ -2,11 +2,17 @@
 fixture-based units proving each rule fires on the bad shape and stays quiet
 on the fixed one — including reproductions of the two historical deadlocks
 (PR 3's read-loop-blocking late-result callback, PR 7's streaming
-self-deadlock) and the two acceptance regressions (removing the
+self-deadlock), the two acceptance regressions (removing the
 ``DeferredReply`` hand-off from a streaming ``run_task``; removing the
-``_patch_lock`` guard from an ``_ActionTemps``-shaped class)."""
+``_patch_lock`` guard from an ``_ActionTemps``-shaped class), and — for the
+cross-process contract families — real-tree mutation fences: deleting a
+``patch_task_refs`` branch, a head ``store_*`` proxy, or a
+``_result_refs`` key, and renaming a contract exception, must each break
+the fence."""
 
+import json
 import os
+import shutil
 import textwrap
 
 import pytest
@@ -29,6 +35,16 @@ def test_tree_is_clean():
     # the suppression inventory is part of the reviewed surface: additions
     # must come through this file so the reason gets a second pair of eyes
     assert len(report.suppressed) <= 12, "\n" + report.render(True)
+
+
+def test_tests_and_benchmarks_knob_fault_scan_is_clean():
+    """The CI sweep leg: the knob and fault-site families over tests/ and
+    benchmarks/ too — direct RDT_* env reads in test code used to escape
+    the package leg entirely."""
+    report = run([PKG, os.path.join(REPO, "tests"),
+                  os.path.join(REPO, "benchmarks")], root=REPO,
+                 rules=["knob-registry", "fault-site-sync"])
+    assert not report.unsuppressed, "\n" + report.render()
 
 
 def test_cli_exit_codes(tmp_path, capsys):
@@ -496,6 +512,7 @@ def test_real_registry_docs_and_defaults():
         else:
             os.environ["RDT_LINEAGE_ROUNDS"] = old
     with pytest.raises(KeyError):
+        # rdtlint: allow[knob-registry] deliberately unregistered: pins the KeyError
         knobs.get("RDT_NOT_A_KNOB")
     with pytest.raises(KeyError):
         knobs.require("RDT_SPMD_JOB_ID")
@@ -605,3 +622,495 @@ def test_suppression_requires_reason(tmp_path):
     msgs = _msgs(report, "knob-registry")
     assert len(msgs) == 1 and "RDT_A" in msgs[0]
     assert len(report.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# rule 5: rpc-surface
+# ---------------------------------------------------------------------------
+
+# a config-known surface class (HeadService) so the mapped receiver "head"
+# resolves strictly against it
+_RPC_SERVER = """
+    class MethodDispatcher:
+        def __init__(self, t):
+            self._t = t
+
+
+    class HeadService:
+        def lookup(self, object_id):
+            return object_id
+
+        def seal(self, object_id, segment, size, kind="raw"):
+            return True
+
+        def ping(self):
+            return "pong"
+
+
+    _dispatch = MethodDispatcher(HeadService())
+"""
+
+_RPC_BAD_CLIENT = """
+    def drive(head):
+        head.call("lokup", "oid")                       # typo'd name
+        head.call("seal", "oid")                        # arity: needs 3
+        head.call("seal", "oid", "seg", 1, junk=True)   # unknown keyword
+        head.call("_reset")                             # underscore target
+"""
+
+_RPC_GOOD_CLIENT = """
+    def drive(head, handle):
+        head.call("lookup", "oid", timeout=5.0)      # timeout= is excluded
+        head.call("seal", "oid", "seg", 3)           # kind= has a default
+        head.call("seal", "oid", "seg", 3, kind="arrow")
+        handle.call("__rdt_spans__", timeout=10.0)   # actor intrinsic
+        head.call(method, "oid")                     # variable name: no check
+"""
+
+
+def test_rpc_rule_catches_typo_arity_and_underscore(tmp_path):
+    report = _lint(tmp_path, {"pkg/head.py": _RPC_SERVER,
+                              "pkg/client.py": _RPC_BAD_CLIENT},
+                   rules=["rpc-surface"])
+    msgs = _msgs(report, "rpc-surface")
+    assert len(msgs) == 4
+    assert any("'lokup'" in m and "resolves on no method" in m for m in msgs)
+    assert any("requires 3" in m for m in msgs)
+    assert any("unknown keyword 'junk'" in m for m in msgs)
+    assert any("underscore method '_reset'" in m for m in msgs)
+
+
+def test_rpc_rule_accepts_matching_calls(tmp_path):
+    report = _lint(tmp_path, {"pkg/head.py": _RPC_SERVER,
+                              "pkg/client.py": _RPC_GOOD_CLIENT},
+                   rules=["rpc-surface"])
+    assert _msgs(report, "rpc-surface") == []
+
+
+_PROXY_STORE = """
+    class ObjectStoreServer:
+        def lookup(self, object_id):
+            return object_id
+
+        def seal(self, object_id, segment, size):
+            return True
+
+        def free(self, ids):
+            return len(ids)
+
+
+    class ObjectStoreClient:
+        def __init__(self, server):
+            self._server = server
+
+        def get(self, oid):
+            return self._server.lookup(oid)
+
+        def put(self, oid):
+            return self._server.seal(oid, "seg", 1)
+
+        def free(self, ids):
+            return self._server.free(ids)
+"""
+
+_PROXY_HEAD_GOOD = """
+    class HeadService:
+        def __init__(self, rt):
+            self._rt = rt
+
+        def store_lookup(self, *a):
+            return self._rt.store_server.lookup(*a)
+
+        def store_seal(self, *a):
+            return self._rt.store_server.seal(*a)
+
+        def store_free(self, *a):
+            return self._rt.store_server.free(*a)
+"""
+
+# the drift shapes: the free proxy is gone, and store_lookup forwards to the
+# WRONG server method (StoreTableProxy routes by name)
+_PROXY_HEAD_BAD = """
+    class HeadService:
+        def __init__(self, rt):
+            self._rt = rt
+
+        def store_lookup(self, *a):
+            return self._rt.store_server.seal(*a)
+
+        def store_seal(self, *a):
+            return self._rt.store_server.seal(*a)
+"""
+
+
+def test_rpc_rule_checks_head_proxy_completeness(tmp_path):
+    report = _lint(tmp_path, {"pkg/object_store.py": _PROXY_STORE,
+                              "pkg/head.py": _PROXY_HEAD_BAD},
+                   rules=["rpc-surface"])
+    msgs = _msgs(report, "rpc-surface")
+    assert any("'free'" in m and "no store_free proxy" in m for m in msgs)
+    assert any("store_lookup" in m and "wrong method" in m for m in msgs)
+
+
+def test_rpc_rule_accepts_complete_proxy_surface(tmp_path):
+    report = _lint(tmp_path, {"pkg/object_store.py": _PROXY_STORE,
+                              "pkg/head.py": _PROXY_HEAD_GOOD},
+                   rules=["rpc-surface"])
+    assert _msgs(report, "rpc-surface") == []
+
+
+_RPC_THREE_SURFACES = """
+    class HeadService:
+        def ping(self):
+            return "pong"
+
+
+    class NodeAgentService:
+        def spawn(self, env, log_name):
+            return 1
+
+
+    class ObjectStoreServer:
+        def lookup(self, object_id):
+            return object_id
+"""
+
+
+def test_rpc_doc_table_drift_and_regeneration(tmp_path):
+    root = _repo(tmp_path, {
+        "pkg/services.py": _RPC_THREE_SURFACES,
+        "doc/dev_lint.md": "# x\n\n<!-- rdtlint:rpc-table:begin -->\n"
+                           "stale\n<!-- rdtlint:rpc-table:end -->\n",
+    })
+    report = run([str(root / "pkg")], root=str(root), rules=["rpc-surface"])
+    assert any("stale" in m and "--write-rpc-docs" in m
+               for m in _msgs(report, "rpc-surface"))
+    assert rdtlint_main([str(root / "pkg"), "--root", str(root),
+                         "--write-rpc-docs"]) == 0
+    report = run([str(root / "pkg")], root=str(root), rules=["rpc-surface"])
+    assert _msgs(report, "rpc-surface") == []
+    text = (root / "doc" / "dev_lint.md").read_text()
+    assert "`spawn`" in text and "`env, log_name`" in text
+
+
+# ---------------------------------------------------------------------------
+# rule 6: step-registry
+# ---------------------------------------------------------------------------
+
+_TASKS_FIXTURE = """
+    from dataclasses import dataclass
+    from typing import List
+
+
+    class ObjectRef:
+        id: str
+
+
+    class Step:
+        pass
+
+
+    @dataclass
+    class ArrowRefSource(Step):  {anno}
+        refs: List[ObjectRef]
+
+
+    @dataclass
+    class PlainStep(Step):
+        column: str
+
+
+    def task_input_ids(task):
+        if isinstance(task, ArrowRefSource):
+            return [r.id for r in task.refs]
+        return []
+
+
+    def _patch_step_refs(step, mapping):
+        {patch_body}
+        return step
+
+
+    def patch_task_refs(task, mapping):
+        return _patch_step_refs(task, mapping)
+
+
+    def stream_sources_of(task):
+        return []
+
+
+    def resolve_stream_sources(task, resolver):
+        return task
+"""
+
+_PATCH_GOOD = """if isinstance(step, ArrowRefSource):
+            step.refs = [mapping.get(r.id, r) for r in step.refs]"""
+_PATCH_MISSING = "del mapping"
+
+
+def _tasks_repo(tmp_path, anno="# carries-refs: refs",
+                patch_body=_PATCH_GOOD):
+    src = _TASKS_FIXTURE.replace("{anno}", anno) \
+        .replace("        {patch_body}", "        " + patch_body)
+    return _lint(tmp_path, {"pkg/etl/tasks.py": src},
+                 rules=["step-registry"])
+
+
+def test_step_rule_accepts_declared_and_handled_carrier(tmp_path):
+    report = _tasks_repo(tmp_path)
+    assert _msgs(report, "step-registry") == []
+
+
+def test_step_rule_catches_undeclared_carrier(tmp_path):
+    report = _tasks_repo(tmp_path, anno="")
+    msgs = _msgs(report, "step-registry")
+    assert len(msgs) == 1 and "ArrowRefSource" in msgs[0] \
+        and "no `# carries-refs:` declaration" in msgs[0]
+
+
+def test_step_rule_catches_unregistered_patch_handler(tmp_path):
+    # the PR 6 BroadcastJoinStep regression shape: the class is declared but
+    # its _patch_step_refs branch is gone
+    report = _tasks_repo(tmp_path, patch_body=_PATCH_MISSING)
+    msgs = _msgs(report, "step-registry")
+    assert len(msgs) == 1 and "_patch_step_refs()" in msgs[0] \
+        and "BroadcastJoinStep regression" in msgs[0]
+
+
+def test_step_rule_catches_stale_declaration(tmp_path):
+    report = _tasks_repo(tmp_path, anno="# carries-refs: refs, bogus")
+    msgs = _msgs(report, "step-registry")
+    assert len(msgs) == 1 and "'bogus'" in msgs[0] \
+        and "stale declaration" in msgs[0]
+
+
+# ---------------------------------------------------------------------------
+# rule 7: exc-contract
+# ---------------------------------------------------------------------------
+
+_EXC_COMMON = {
+    "pkg/rpc.py": """
+        class RpcError(Exception):
+            pass
+
+
+        class ConnectionLost(RpcError):
+            pass
+
+
+        class RemoteError(RpcError):
+            def __init__(self, exc_type):
+                self.exc_type = exc_type
+        """,
+    "pkg/store.py": """
+        class ObjectLostError(KeyError):
+            pass
+        """,
+}
+
+_EXC_GOOD = """
+    _NO_RETRY = ("ValueError", "ObjectLostError")
+
+
+    def handle(err):
+        if err.exc_type == "ObjectLostError":
+            return "recover"
+        if err.exc_type in _NO_RETRY:
+            return "fail"
+        if getattr(err, "exc_type", None) == "FileNotFoundError":
+            return "retry"
+        if type(err).__name__ == "ConnectionLost":
+            return "reconnect"
+        return "other"
+"""
+
+_EXC_BAD = """
+    _NO_RETRY = ("ValueError", "ShufleStreamAborted")
+
+
+    def handle(err):
+        if err.exc_type == "ObjectGoneError":
+            return "recover"
+        if err.exc_type in _NO_RETRY:
+            return "fail"
+        if type(err).__name__ == "ConectionLost":
+            return "reconnect"
+        return "other"
+"""
+
+
+def test_exc_rule_catches_stale_exception_strings(tmp_path):
+    files = dict(_EXC_COMMON, **{"pkg/engine.py": _EXC_BAD})
+    report = _lint(tmp_path, files, rules=["exc-contract"])
+    msgs = _msgs(report, "exc-contract")
+    assert len(msgs) == 3
+    for name in ("ObjectGoneError", "ShufleStreamAborted", "ConectionLost"):
+        assert any(repr(name) in m for m in msgs)
+
+
+def test_exc_rule_accepts_real_builtin_and_repo_exceptions(tmp_path):
+    files = dict(_EXC_COMMON, **{"pkg/engine.py": _EXC_GOOD})
+    report = _lint(tmp_path, files, rules=["exc-contract"])
+    assert _msgs(report, "exc-contract") == []
+
+
+def test_exc_rule_skipped_without_rpc_module(tmp_path):
+    # no RemoteError in scope → no exc_type contract to check
+    report = _lint(tmp_path, {"pkg/engine.py": _EXC_BAD},
+                   rules=["exc-contract"])
+    assert _msgs(report, "exc-contract") == []
+
+
+# ---------------------------------------------------------------------------
+# real-tree mutation fences (acceptance): deleting any single registration
+# from the live sources must break the fence
+# ---------------------------------------------------------------------------
+
+def _real_subtree(tmp_path, rels, mutations=()):
+    """A throwaway repo holding REAL package files (mirrored paths), with
+    textual mutations applied — each must match exactly once."""
+    root = tmp_path / "mut"
+    (root / "raydp_tpu").mkdir(parents=True)
+    (root / "pyproject.toml").write_text("[project]\nname='x'\n")
+    for rel in rels:
+        dst = root / "raydp_tpu" / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(os.path.join(PKG, rel), dst)
+    for rel, old, new in mutations:
+        p = root / "raydp_tpu" / rel
+        text = p.read_text()
+        assert text.count(old) >= 1, f"mutation anchor gone from {rel}: {old!r}"
+        p.write_text(text.replace(old, new))
+    return root
+
+
+_ETL_RELS = ("etl/tasks.py", "etl/engine.py", "etl/executor.py")
+
+
+def test_fence_breaks_when_patch_task_refs_branch_deleted(tmp_path):
+    root = _real_subtree(tmp_path, _ETL_RELS)
+    clean = run([str(root / "raydp_tpu")], root=str(root),
+                rules=["step-registry"])
+    assert _msgs(clean, "step-registry") == []
+
+    root = _real_subtree(tmp_path / "b", _ETL_RELS, mutations=[
+        ("etl/tasks.py", "elif isinstance(step, BroadcastJoinStep):",
+         "elif False:")])
+    report = run([str(root / "raydp_tpu")], root=str(root),
+                 rules=["step-registry"])
+    msgs = _msgs(report, "step-registry")
+    assert any("BroadcastJoinStep" in m and "_patch_step_refs()" in m
+               for m in msgs)
+
+
+def test_fence_breaks_when_result_ref_key_unharvested(tmp_path):
+    root = _real_subtree(tmp_path, _ETL_RELS, mutations=[
+        ("etl/engine.py",
+         '    if r.get("ref") is not None:\n        refs.append(r["ref"])\n'
+         "    return refs",
+         "    return refs")])
+    report = run([str(root / "raydp_tpu")], root=str(root),
+                 rules=["step-registry"])
+    msgs = _msgs(report, "step-registry")
+    assert any("'ref'" in m and "_result_refs" in m and "orphan" in m
+               for m in msgs)
+
+
+def test_fence_breaks_when_locality_drops_stream_buckets(tmp_path):
+    root = _real_subtree(tmp_path, _ETL_RELS, mutations=[
+        ("etl/engine.py",
+         "elif isinstance(item, _StreamBucket):\n"
+         "                    yield from item.parts_so_far()",
+         "elif False:\n                    pass")])
+    report = run([str(root / "raydp_tpu")], root=str(root),
+                 rules=["step-registry"])
+    msgs = _msgs(report, "step-registry")
+    assert any("_locality()" in m and "_StreamBucket" in m for m in msgs)
+
+
+_RPC_RELS = ("runtime/head.py", "runtime/object_store.py")
+
+
+def test_fence_breaks_when_head_store_proxy_deleted(tmp_path):
+    root = _real_subtree(tmp_path, _RPC_RELS)
+    clean = run([str(root / "raydp_tpu")], root=str(root),
+                rules=["rpc-surface"])
+    assert _msgs(clean, "rpc-surface") == []
+
+    root = _real_subtree(tmp_path / "b", _RPC_RELS, mutations=[
+        ("runtime/head.py", "def store_lookup(self, *a):",
+         "def _store_lookup_disabled(self, *a):")])
+    report = run([str(root / "raydp_tpu")], root=str(root),
+                 rules=["rpc-surface"])
+    msgs = _msgs(report, "rpc-surface")
+    assert any("'lookup'" in m and "no store_lookup proxy" in m
+               for m in msgs)
+
+
+def test_fence_breaks_when_contract_exception_renamed(tmp_path):
+    rels = ("etl/engine.py", "runtime/rpc.py", "runtime/object_store.py")
+    root = _real_subtree(tmp_path, rels)
+    clean = run([str(root / "raydp_tpu")], root=str(root),
+                rules=["exc-contract"])
+    assert _msgs(clean, "exc-contract") == []
+
+    root = _real_subtree(tmp_path / "b", rels, mutations=[
+        ("etl/engine.py", '"ShuffleStreamAborted",',
+         '"ShufleStreamAborted",')])
+    report = run([str(root / "raydp_tpu")], root=str(root),
+                 rules=["exc-contract"])
+    msgs = _msgs(report, "exc-contract")
+    assert any("'ShufleStreamAborted'" in m for m in msgs)
+
+
+def test_real_rpc_call_sites_all_resolve():
+    """Every literal call site in the live package resolves (the fence), and
+    the surface map actually contains the load-bearing surfaces."""
+    from raydp_tpu.tools.rdtlint import surfaces
+    from raydp_tpu.tools.rdtlint.core import Project
+
+    project = Project.load([PKG], root=REPO)
+    smap = surfaces.build(project)
+    assert "actor_ready" in smap.methods("head")
+    assert smap.methods("head")["store_seal"].note \
+        == "proxy → ObjectStoreServer.seal"
+    assert "spawn" in smap.methods("agent")
+    assert "run_function" in smap.methods("worker")
+    assert smap.methods("worker")["run_function"].min_pos == 2
+
+
+# ---------------------------------------------------------------------------
+# CLI --json
+# ---------------------------------------------------------------------------
+
+def test_cli_json_output(tmp_path, capsys):
+    bad = _repo(tmp_path, {"pkg/m.py": "import os\n"
+                           "V = os.environ.get('RDT_X')\n"})
+    assert rdtlint_main([str(bad / "pkg"), "--root", str(bad),
+                         "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["files_linted"] == 1
+    (v,) = payload["violations"]
+    assert v["file"].endswith("m.py") and v["line"] == 2
+    assert v["rule"] == "knob-registry" and "RDT_X" in v["message"]
+    assert v["suppressed"] is False and v["reason"] == ""
+    # clean tree → empty violations, exit 0
+    capsys.readouterr()
+    assert rdtlint_main([PKG, "--root", REPO, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["violations"] == [] and payload["suppressed"] >= 1
+
+
+def test_write_rpc_docs_fails_loudly_on_missing_doc_or_markers(tmp_path,
+                                                               capsys):
+    # success while the drift fence keeps failing would be a trap: a wrong
+    # --root or missing markers must exit 2 with the cause, not print nothing
+    root = _repo(tmp_path, {"pkg/services.py": _RPC_THREE_SURFACES})
+    assert rdtlint_main([str(root / "pkg"), "--root", str(root),
+                         "--write-rpc-docs"]) == 2
+    assert "wrong --root" in capsys.readouterr().err
+    (root / "doc").mkdir()
+    (root / "doc" / "dev_lint.md").write_text("# no markers here\n")
+    assert rdtlint_main([str(root / "pkg"), "--root", str(root),
+                         "--write-rpc-docs"]) == 2
+    assert "markers" in capsys.readouterr().err

@@ -38,6 +38,25 @@ class Counter:
         return get_client().put(table)
 
 
+def test_method_dispatcher_unknown_method_lists_surface():
+    """A typo'd remote call fails with the target's sorted remote surface in
+    the message — actionable from inside the RemoteError a driver sees —
+    while underscore methods stay refused without leaking the surface."""
+    from raydp_tpu.runtime.rpc import MethodDispatcher
+
+    dispatch = MethodDispatcher(Counter())
+    assert dispatch("incr", (), {}) == 1
+    with pytest.raises(AttributeError) as ei:
+        dispatch("inrc", (), {})
+    msg = str(ei.value)
+    assert "Counter has no remote method 'inrc'" in msg
+    assert "remote surface: crash, get, incr, put_table, whoami" in msg
+    with pytest.raises(AttributeError) as ei:
+        dispatch("_private", (), {})
+    assert "not remotely callable" in str(ei.value)
+    assert "remote surface" not in str(ei.value)
+
+
 def test_object_store_roundtrip(runtime):
     client = runtime.store_client
     ref = client.put({"a": 1, "b": [1, 2, 3]})

@@ -34,6 +34,7 @@ def test_env_propagation():
                           env={"RDT_TEST_MARKER": "hello"}, timeout=60)
     job.start()
     try:
+        # rdtlint: allow[knob-registry] probes extra_env propagation, not a knob
         got = job.run(lambda ctx: os.environ.get("RDT_TEST_MARKER"))
         assert got == ["hello", "hello"]
     finally:
