@@ -407,6 +407,31 @@ class EtlExecutor:
             "schema": table.schema.serialize().to_pybytes(),
         })
 
+    # -- serving replicas (raydp_tpu/serve/replica.py) -------------------------
+    def serve_load(self, replica_id: str, export_dir: str) -> Dict[str, Any]:
+        """(Re)load a serving replica in this process from an exported
+        bundle; idempotent per (id, dir). A restarted executor comes back
+        with an empty registry — the driver calls this again on the
+        ``ReplicaNotLoaded`` signal."""
+        from raydp_tpu.serve import replica as serve_replica
+        return serve_replica.load(replica_id, export_dir, self._actor_name)
+
+    def serve_predict(self, replica_id: str, payload: bytes):
+        """One encoded micro-batch → prediction array. Enqueues onto the
+        replica's worker (decode/stage/H2D overlap the jitted apply there)
+        and returns a DeferredReply — a slow model never parks this bounded
+        dispatcher pool."""
+        from raydp_tpu.serve import replica as serve_replica
+        return serve_replica.predict(replica_id, payload)
+
+    def serve_unload(self, replica_id: str) -> bool:
+        from raydp_tpu.serve import replica as serve_replica
+        return serve_replica.unload(replica_id)
+
+    def serve_stats(self) -> Dict[str, Any]:
+        from raydp_tpu.serve import replica as serve_replica
+        return serve_replica.stats()
+
     # -- data-plane server (parity: getRDDPartition) ---------------------------
     def get_block(self, cache_key: str, recover_bytes: Optional[bytes] = None,
                   owner: Optional[str] = None) -> Dict[str, Any]:

@@ -20,7 +20,8 @@ Spec grammar (documented in doc/fault_tolerance.md)::
     rule       = site ':' action (':' key '=' value)*
 
     sites   : executor.run_task | shuffle.write | shuffle.fetch | store.get
-              | rpc.call | estimator.epoch   (any string; sites are just names)
+              | rpc.call | estimator.epoch | serve.predict
+              (env specs must name a KNOWN_SITES entry)
     actions : crash | delay | raise | drop | connloss   (interpreted by the site)
     keys    : nth= every= p= times= seed= match= once= ms= ms_per_mb= bucket=
 
@@ -72,6 +73,7 @@ KNOWN_SITES = frozenset((
     "store.get",
     "rpc.call",
     "estimator.epoch",
+    "serve.predict",
 ))
 
 #: the site-specific actions and the only call sites that interpret them —

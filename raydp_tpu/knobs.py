@@ -58,7 +58,8 @@ class Knob:
     type: str          # "bool" | "int" | "float" | "str"
     default: object    # typed default; None = unset (or computed at the site)
     scope: str         # PER_ACTION | PROCESS_START
-    category: str      # "etl" | "training" | "runtime" | "faults" | "spmd"
+    category: str      # "etl" | "training" | "serving" | "runtime"
+                       # | "faults" | "spmd"
     doc: str           # one-line description for the generated doc tables
     #: framework-injected IPC value (set by the head/agent/submit wrapper for
     #: child processes), not a user-facing tuning knob
@@ -146,6 +147,37 @@ _ALL = [
        "device residency."),
     _k("RDT_STAGE_THREADS", "int", 1, PER_ACTION, "training",
        "Column fan-out threads of the native staging core (host decode)."),
+    # ---- serving plane ------------------------------------------------------
+    _k("RDT_SERVE_MAX_BATCH", "int", 64, PER_ACTION, "serving",
+       "Micro-batch row cap: concurrent predict() requests coalesce into "
+       "one replica dispatch up to this many rows. Read at serving-session "
+       "construction."),
+    _k("RDT_SERVE_BATCH_TIMEOUT_MS", "float", 5.0, PER_ACTION, "serving",
+       "Latency budget a partially-filled micro-batch waits for more rows "
+       "before dispatching anyway."),
+    _k("RDT_SERVE_MAX_INFLIGHT", "int", 2, PER_ACTION, "serving",
+       "Per-replica in-flight dispatch cap; dispatches queue driver-side "
+       "once every ready replica is at its cap."),
+    _k("RDT_SERVE_HEDGE", "bool", True, PER_ACTION, "serving",
+       "Hedged requests: a dispatch older than the hedge deadline is "
+       "duplicated onto a second replica; first responder wins, the "
+       "loser's result is discarded and counted."),
+    _k("RDT_SERVE_HEDGE_QUANTILE", "float", 0.9, PER_ACTION, "serving",
+       "Completed-batch latency quantile the hedge deadline is computed "
+       "from."),
+    _k("RDT_SERVE_HEDGE_MULTIPLIER", "float", 3.0, PER_ACTION, "serving",
+       "Hedge deadline = this multiple of the latency quantile."),
+    _k("RDT_SERVE_HEDGE_MIN_MS", "float", 20.0, PER_ACTION, "serving",
+       "Floor under the hedge deadline: dispatches younger than this "
+       "never hedge."),
+    _k("RDT_SERVE_REROUTE_GRACE_S", "float", 60.0, PER_ACTION, "serving",
+       "Wall-clock grace a failed/unroutable dispatch keeps re-routing "
+       "across replicas (sized for an executor restart + replica reload) "
+       "before failing the request."),
+    _k("RDT_SERVE_PREFETCH", "int", 2, PER_ACTION, "serving",
+       "Staged batches a replica keeps decoded + device-placed ahead of "
+       "its jitted apply (the DevicePrefetcher depth). Read at replica "
+       "load."),
     # ---- runtime ------------------------------------------------------------
     _k("RDT_LOG_LEVEL", "str", "INFO", PROCESS_START, "runtime",
        "Log level of spawned processes (node agents, SPMD rank workers)."),
@@ -269,6 +301,7 @@ def generate_table(category: Optional[str] = None) -> str:
 DOC_TABLES = (
     ("doc/etl.md", "etl"),
     ("doc/training.md", "training"),
+    ("doc/serving.md", "serving"),
     ("doc/dev_lint.md", None),
 )
 

@@ -1,0 +1,18 @@
+"""The serving plane: trained estimators behind a micro-batched, hedged
+inference service over the executor pool (doc/serving.md).
+
+    est.fit_on_frame(train_df)
+    est.export_serving("/shared/model-v1")
+    with ServingSession("/shared/model-v1", session=session) as srv:
+        preds = srv.predict(rows)
+"""
+
+from raydp_tpu.serve.servable import (  # noqa: F401
+    Servable, export_bundle, load_servable,
+)
+from raydp_tpu.serve.session import (  # noqa: F401
+    ServingError, ServingSession,
+)
+
+__all__ = ["Servable", "ServingError", "ServingSession", "export_bundle",
+           "load_servable"]

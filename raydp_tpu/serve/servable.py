@@ -1,0 +1,235 @@
+"""Serving bundles: export a trained estimator, load it anywhere.
+
+The RayDP reference's Estimator surface stops at ``fit``/``get_model``
+(PAPER.md L5) — its users rebuild an inference loop by hand around the
+returned model. A *servable* closes that gap: ``FlaxEstimator.export_serving``
+/ ``KerasEstimator.export_serving`` write a self-contained directory
+
+- ``servable.json`` — kind ("flax" | "keras") + format version,
+- ``predict.pkl``  — the cloudpickled model object plus everything the
+  estimator's own ``predict()`` used (column spec, preprocessor, cast
+  policy, ``train=`` kwarg detection) and a shape/dtype template tree,
+- ``ckpt/``        — the trained weights written through
+  :mod:`raydp_tpu.train.checkpoint` (the same format ``fit`` checkpoints
+  use, so a serving bundle restores with the exact machinery a resumed
+  training run trusts),
+
+and :func:`load_servable` rebuilds a :class:`Servable` in any process — the
+driver for local smoke checks, or an executor actor as a serving replica
+(:mod:`raydp_tpu.serve.replica`). Multi-host pools need ``export_dir`` on
+shared storage, the same contract gang checkpoints already carry.
+
+A Servable splits inference into the three phases the replica pipeline
+overlaps (doc/serving.md): ``decode`` (Arrow → host arrays), ``place``
+(host → device), ``apply`` (the jitted forward pass).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Tuple
+
+import cloudpickle
+import numpy as np
+import pyarrow as pa
+
+from raydp_tpu.log import get_logger
+from raydp_tpu.train import checkpoint
+
+logger = get_logger("serve.servable")
+
+META_FILE = "servable.json"
+BUNDLE_FILE = "predict.pkl"
+CKPT_SUBDIR = "ckpt"
+FORMAT_VERSION = 1
+
+
+def _template_spec(state):
+    """A shapes/dtypes-only twin of ``state`` — small enough to pickle into
+    the bundle, rich enough for ``checkpoint.restore`` to rebuild host
+    arrays into (its ``_host_template`` only reads ``.shape``/``.dtype``)."""
+    import jax
+
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            np.shape(x), getattr(x, "dtype", None) or np.asarray(x).dtype),
+        state)
+
+
+def _host_tree(state):
+    import jax
+
+    return jax.tree.map(lambda x: np.asarray(x), state)
+
+
+def export_bundle(export_dir: str, kind: str, bundle: Dict[str, Any],
+                  state) -> str:
+    """Write a servable directory: meta + pickled bundle + the weight tree
+    through ``checkpoint.save`` (step 0 — a bundle is a single immutable
+    export, not a training timeline)."""
+    os.makedirs(export_dir, exist_ok=True)
+    bundle = dict(bundle)
+    bundle["template"] = _template_spec(state)
+    checkpoint.save(os.path.join(export_dir, CKPT_SUBDIR),
+                    _host_tree(state), step=0)
+    with open(os.path.join(export_dir, BUNDLE_FILE), "wb") as f:
+        f.write(cloudpickle.dumps(bundle))
+    meta = {"kind": kind, "format_version": FORMAT_VERSION}
+    tmp = os.path.join(export_dir, f".{META_FILE}.tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+    # meta lands last and atomically: its presence marks a complete bundle
+    os.replace(tmp, os.path.join(export_dir, META_FILE))
+    logger.info("exported %s servable to %s", kind, export_dir)
+    return export_dir
+
+
+class Servable:
+    """A loaded model with the three-phase predict pipeline.
+
+    ``predict_table`` chains the phases synchronously; the replica worker
+    runs ``decode``+``place`` on a :class:`~raydp_tpu.data.feed
+    .DevicePrefetcher` thread so batch ``k+1``'s staging and H2D overlap the
+    jitted ``apply`` of batch ``k``."""
+
+    def __init__(self, kind: str, columns: Dict[str, Tuple[Any, Any]],
+                 apply_fn, nbytes: int):
+        self.kind = kind
+        #: feed-style column spec: name -> (column(s), dtype)
+        self.columns = columns
+        self._apply = apply_fn
+        #: total weight bytes — the replica load report surfaces it
+        self.nbytes = nbytes
+
+    # -- decode ---------------------------------------------------------------
+    def decode(self, table: pa.Table) -> Dict[str, np.ndarray]:
+        """Arrow → the host batch dict the jitted apply consumes. Spec
+        entries whose column(s) the table lacks wholesale (the label a
+        serving request never carries) synthesize as zeros, exactly like
+        ``FlaxEstimator.predict``; a partially-missing entry is a schema
+        mismatch and fails loudly."""
+        from raydp_tpu.data.feed import _as_numpy
+
+        have = set(table.schema.names)
+        batch: Dict[str, np.ndarray] = {}
+        for name, (cspec, dt) in self.columns.items():
+            cnames = (cspec,) if isinstance(cspec, str) else tuple(cspec)
+            missing = [c for c in cnames if c not in have]
+            if missing and len(missing) < len(cnames):
+                raise ValueError(
+                    f"servable spec entry {name!r} is partially missing from "
+                    f"the request schema: missing {missing}")
+            if missing:
+                shape = ((table.num_rows,) if len(cnames) == 1
+                         else (table.num_rows, len(cnames)))
+                batch[name] = np.zeros(shape, np.dtype(dt))
+            else:
+                batch[name] = _as_numpy(table, list(cnames), dt)
+        return batch
+
+    # -- place ----------------------------------------------------------------
+    @staticmethod
+    def place(batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """Host batch → device arrays (the H2D phase)."""
+        import jax
+
+        return {k: jax.device_put(v) for k, v in batch.items()}
+
+    # -- apply ----------------------------------------------------------------
+    def apply(self, placed: Dict[str, Any]) -> np.ndarray:
+        """The jitted forward pass; returns float32 host predictions, one
+        row per input row."""
+        return np.asarray(self._apply(placed))
+
+    def predict_table(self, table: pa.Table) -> np.ndarray:
+        return self.apply(self.place(self.decode(table)))
+
+
+def _restore_state(export_dir: str, template):
+    restored = checkpoint.restore(os.path.join(export_dir, CKPT_SUBDIR),
+                                  template)
+    if restored is None:
+        raise FileNotFoundError(
+            f"servable at {export_dir!r} has no complete checkpoint under "
+            f"{CKPT_SUBDIR}/")
+    return restored[0]
+
+
+def _tree_nbytes(state) -> int:
+    import jax
+
+    return sum(int(np.asarray(x).nbytes)
+               for x in jax.tree.leaves(state))
+
+
+def _build_flax(bundle: Dict[str, Any], state) -> Servable:
+    import jax
+    import jax.numpy as jnp
+
+    from raydp_tpu.train.flax_estimator import _cast_floating
+
+    model = bundle["model"]
+    preprocessor = bundle.get("preprocessor")
+    custom = bool(bundle.get("custom"))
+    compute_dtype = bundle.get("compute_dtype")
+    kwargs = {"train": False} if bundle.get("takes_train") else {}
+    variables = state
+
+    @jax.jit
+    def infer(jbatch):
+        if custom:
+            inputs = (preprocessor(jbatch)[0] if preprocessor is not None
+                      else jbatch["features"])
+        else:
+            inputs = jbatch["features"]
+        inputs = _cast_floating(inputs, compute_dtype)
+        preds = model.apply(variables, inputs, **kwargs)
+        if preds.ndim >= 2 and preds.shape[-1] == 1:
+            preds = preds.squeeze(-1)
+        return preds.astype(jnp.float32)
+
+    return Servable("flax", bundle["columns"], infer, _tree_nbytes(state))
+
+
+def _build_keras(bundle: Dict[str, Any], state) -> Servable:
+    import jax
+    import jax.numpy as jnp
+
+    model = bundle["model"]
+    tv = [jnp.asarray(v) for v in state["tv"]]
+    ntv = [jnp.asarray(v) for v in state["ntv"]]
+
+    @jax.jit
+    def infer(jbatch):
+        preds, _ = model.stateless_call(tv, ntv, jbatch["features"],
+                                        training=False)
+        if preds.ndim >= 2 and preds.shape[-1] == 1:
+            preds = preds.squeeze(-1)
+        return preds.astype(jnp.float32)
+
+    return Servable("keras", bundle["columns"], infer, _tree_nbytes(state))
+
+
+_BUILDERS = {"flax": _build_flax, "keras": _build_keras}
+
+
+def load_servable(export_dir: str) -> Servable:
+    """Rebuild a :class:`Servable` from an exported directory (weights
+    restored through ``train/checkpoint.py``, like any training resume)."""
+    meta_path = os.path.join(export_dir, META_FILE)
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(
+            f"no servable at {export_dir!r} ({META_FILE} missing — was "
+            "export_serving() called, and is the path visible on this "
+            "machine?)")
+    with open(meta_path, "r", encoding="utf-8") as f:
+        meta = json.load(f)
+    kind = meta.get("kind")
+    builder = _BUILDERS.get(kind)
+    if builder is None:
+        raise ValueError(f"unknown servable kind {kind!r} in {export_dir!r}")
+    with open(os.path.join(export_dir, BUNDLE_FILE), "rb") as f:
+        bundle = cloudpickle.loads(f.read())
+    state = _restore_state(export_dir, bundle["template"])
+    return builder(bundle, state)

@@ -1,0 +1,713 @@
+"""Driver-side serving sessions: micro-batched, hedged inference over the
+executor pool.
+
+:class:`ServingSession` loads an exported servable
+(``estimator.export_serving(dir)``) onto N executor-resident replicas and
+exposes a thread-safe ``predict(batch)`` / ``predict_async(rows)`` API over
+the existing actor RPC plane. Mechanisms, each reusing an ETL-plane design:
+
+- **dynamic micro-batching** — concurrent requests coalesce into one device
+  dispatch up to ``RDT_SERVE_MAX_BATCH`` rows or an
+  ``RDT_SERVE_BATCH_TIMEOUT_MS`` latency budget; the batched output demuxes
+  back per request. The replica side stages decode/H2D for the next batch
+  on a ``DevicePrefetcher`` thread while the jitted apply runs (PR 1).
+- **replica routing + hedged requests** — dispatches land on the
+  least-busy replica (per-replica in-flight counters, ties rotating — the
+  PR 5 scheduler's shape); a dispatch older than
+  ``max(RDT_SERVE_HEDGE_MULTIPLIER × latency-quantile,
+  RDT_SERVE_HEDGE_MIN_MS)`` is hedged onto a second replica, first
+  responder wins, the loser's result is discarded and counted (PR 5's
+  speculation, re-aimed at tail latency).
+- **fault path** — a replica that dies mid-request (connection lost, or a
+  restarted executor answering ``ReplicaNotLoaded``) re-routes the dispatch
+  through the same hedge machinery instead of surfacing an error; the
+  replica reloads in the background and rejoins the rotation. Requests fail
+  only when every replica has refused within the re-route grace.
+- **observability** — per-replica request/batch/row counters, batch
+  occupancy and queue-depth gauges, and request p50/p99 in
+  :meth:`serving_report` (the ``shuffle_stage_report`` twin), plus
+  ``serve:batch`` / ``serve:hedge`` trace spans.
+
+All routing/hedging/demux state is owned by ONE dispatcher thread fed by an
+event queue — RPC completion callbacks (which run on client read-loop
+threads) only enqueue, so no lock ordering exists to get wrong and the
+read loops never block.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from raydp_tpu import knobs, profiler
+from raydp_tpu.log import get_logger
+from raydp_tpu.runtime.rpc import ConnectionLost, RemoteError
+
+logger = get_logger("serve.session")
+
+#: completed-batch latencies required before the hedge deadline is trusted
+#: (below this the quantile is noise and hedging would fire on warmup jitter)
+_HEDGE_MIN_SAMPLES = 8
+#: bounded latency reservoirs (batch + request) for the quantile/report
+_LAT_WINDOW = 2048
+
+
+class ServingError(RuntimeError):
+    """A request failed on every live replica within the re-route grace."""
+
+
+#: ``RemoteError.exc_type`` values that mark a replica/infrastructure
+#: failure worth re-routing: a restarted executor's empty registry, and the
+#: chaos plane's transient ``raise`` (doc/serving.md failure table). Any
+#: other remote exception is a deterministic application error — replaying
+#: it on another replica replays the error, so it fails fast instead.
+_REROUTE_EXC_TYPES = ("ReplicaNotLoaded", "InjectedFault")
+
+
+def _reroutable(err: BaseException) -> bool:
+    if isinstance(err, (ConnectionLost, OSError)):
+        return True
+    return isinstance(err, RemoteError) \
+        and err.exc_type in _REROUTE_EXC_TYPES
+
+
+def _as_table(data) -> pa.Table:
+    if isinstance(data, pa.Table):
+        return data
+    if isinstance(data, dict):
+        return pa.table({k: np.asarray(v) for k, v in data.items()})
+    try:
+        import pandas as pd
+        if isinstance(data, pd.DataFrame):
+            return pa.Table.from_pandas(data, preserve_index=False)
+    except ImportError:  # pragma: no cover - pandas is a hard dep elsewhere
+        pass
+    raise TypeError(f"cannot serve rows of type {type(data)}; pass a "
+                    "pyarrow Table, pandas DataFrame, or dict of arrays")
+
+
+def _encode(table: pa.Table) -> bytes:
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
+def _quantile(sample: Sequence[float], q: float) -> float:
+    s = sorted(sample)
+    if not s:
+        return 0.0
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+class _Request:
+    __slots__ = ("table", "fut", "t_enq", "rows")
+
+    def __init__(self, table: pa.Table, fut: Future):
+        self.table = table
+        self.fut = fut
+        self.t_enq = time.monotonic()
+        self.rows = table.num_rows
+
+
+class _Attempt:
+    __slots__ = ("replica", "t0", "hedge")
+
+    def __init__(self, replica: "_ReplicaState", t0: float, hedge: bool):
+        self.replica = replica
+        self.t0 = t0
+        self.hedge = hedge
+
+
+class _Dispatch:
+    """One coalesced batch in flight (possibly on two replicas at once)."""
+
+    __slots__ = ("id", "payload", "rows", "parts", "attempts", "tried",
+                 "hedged", "done", "t_first", "last_error")
+
+    def __init__(self, did: int, payload: bytes, rows: int, parts):
+        self.id = did
+        self.payload = payload
+        self.rows = rows
+        self.parts = parts            # [(request, row offset)]
+        self.attempts: Dict[int, _Attempt] = {}
+        self.tried: set = set()       # replica ids an attempt ran on
+        self.hedged = False
+        self.done = False
+        self.t_first = time.monotonic()
+        self.last_error: Optional[BaseException] = None
+
+
+class _ReplicaState:
+    """Driver-side view of one replica: its actor handle, its in-flight
+    count, and its readiness (False while the executor restarts/reloads)."""
+
+    def __init__(self, rid: str, replica, executor_name: str):
+        self.rid = rid
+        #: the ActorHandle — named `replica` so rdtlint's rpc-surface rule
+        #: resolves `replica.submit("serve_predict", ...)` call sites against
+        #: the actor surface (tools/rdtlint/config.py RPC_RECEIVER_SURFACES)
+        self.replica = replica
+        self.executor = executor_name
+        self.inflight = 0
+        self.inflight_peak = 0
+        self.ready = True
+        self.reloading = False
+        # counters for serving_report()
+        self.requests = 0
+        self.batches = 0
+        self.rows = 0
+        self.hedges = 0
+        self.reloads = 0
+
+
+class ServingSession:
+    """See module docstring. Construct with a live ETL session (or an
+    explicit executor-handle list) and a servable ``export_dir``:
+
+        est.fit_on_frame(train_df)
+        est.export_serving("/shared/model-v1")
+        srv = ServingSession("/shared/model-v1", session=session)
+        preds = srv.predict(rows)          # or predict_async(rows) -> Future
+        srv.serving_report(); srv.close()
+
+    Knobs (all re-read at construction; doc/serving.md): batching
+    ``RDT_SERVE_MAX_BATCH`` / ``RDT_SERVE_BATCH_TIMEOUT_MS``, routing
+    ``RDT_SERVE_MAX_INFLIGHT``, hedging ``RDT_SERVE_HEDGE`` /
+    ``RDT_SERVE_HEDGE_QUANTILE`` / ``RDT_SERVE_HEDGE_MULTIPLIER`` /
+    ``RDT_SERVE_HEDGE_MIN_MS``, fault path ``RDT_SERVE_REROUTE_GRACE_S``,
+    replica staging ``RDT_SERVE_PREFETCH``."""
+
+    def __init__(self, export_dir: str, session=None,
+                 executors: Optional[List] = None,
+                 num_replicas: Optional[int] = None,
+                 name: str = "serving"):
+        if executors is None:
+            if session is None:
+                from raydp_tpu.context import active_session
+                session = active_session()
+            if session is None:
+                raise ValueError("pass session= or executors= (no active "
+                                 "raydp_tpu session to serve from)")
+            executors = list(session.executors)
+        if not executors:
+            raise ValueError("serving needs at least one executor")
+        if num_replicas is not None:
+            if num_replicas < 1:
+                raise ValueError("num_replicas must be >= 1")
+            executors = [executors[i % len(executors)]
+                         for i in range(num_replicas)]
+        self.export_dir = export_dir
+        self.name = name
+        self._max_batch = max(1, int(knobs.get("RDT_SERVE_MAX_BATCH")))
+        self._timeout_s = max(
+            0.0, float(knobs.get("RDT_SERVE_BATCH_TIMEOUT_MS")) / 1000.0)
+        self._max_inflight = max(1, int(knobs.get("RDT_SERVE_MAX_INFLIGHT")))
+        self._hedge_on = bool(knobs.get("RDT_SERVE_HEDGE"))
+        self._hedge_q = float(knobs.get("RDT_SERVE_HEDGE_QUANTILE"))
+        self._hedge_mult = float(knobs.get("RDT_SERVE_HEDGE_MULTIPLIER"))
+        self._hedge_min_s = max(
+            0.0, float(knobs.get("RDT_SERVE_HEDGE_MIN_MS")) / 1000.0)
+        self._reroute_grace_s = float(knobs.get("RDT_SERVE_REROUTE_GRACE_S"))
+
+        self._replicas: List[_ReplicaState] = []
+        loads = []
+        for i, h in enumerate(executors):
+            rid = f"{name}-r{i}"
+            rep = _ReplicaState(rid, h, getattr(h, "name", None) or f"ex{i}")
+            # parallel load: each replica pays its jax import + jit once,
+            # concurrently, instead of serializing session bring-up
+            replica = rep.replica
+            loads.append(replica.submit("serve_load", rid, export_dir))
+            self._replicas.append(rep)
+        for f in loads:
+            f.result(timeout=180.0)
+
+        # dispatcher-owned state (no locks: one thread mutates it)
+        self._events: "queue.Queue" = queue.Queue()
+        self._pending: List[_Request] = []     # awaiting coalescing
+        self._pending_rows = 0
+        self._inflight: Dict[int, _Dispatch] = {}
+        self._parked: List[_Dispatch] = []     # waiting for a replica
+        self._rr = itertools.count()
+        self._did = itertools.count()
+        self._closed = False
+        self._batch_lat: List[float] = []      # bounded; hedge quantile base
+        self._req_lat: List[float] = []        # bounded; report p50/p99
+        self._occupancy: List[int] = []        # rows per dispatched batch
+        self._queue_depth_peak = 0
+        self._stats = {"requests": 0, "batches": 0, "rows": 0,
+                       "hedged": 0, "hedge_won": 0, "hedge_lost": 0,
+                       "rerouted": 0, "failed": 0}
+        self._dispatcher = threading.Thread(
+            target=self._run, daemon=True, name=f"rdt-serve-dispatch-{name}")
+        self._dispatcher.start()
+
+    # ---- public API ---------------------------------------------------------
+    def predict_async(self, rows) -> Future:
+        """Enqueue rows (Table / DataFrame / dict of arrays); the Future
+        resolves to a float32 prediction array, one entry per input row.
+        Thread-safe; callable from any number of request threads."""
+        table = _as_table(rows)
+        fut: Future = Future()
+        if table.num_rows == 0:
+            fut.set_result(np.empty((0,), np.float32))
+            return fut
+        if self._closed:
+            raise ServingError("serving session is closed")
+        self._events.put(("req", _Request(table, fut)))
+        if self._closed and not fut.done():
+            # close() raced the enqueue: the request may sit behind the
+            # stop event on a queue nobody drains anymore — fail it here
+            # rather than leave a Future that never resolves (the winner
+            # path guards set_result with done(), so the benign double
+            # race resolves to whichever side got there first)
+            try:
+                fut.set_exception(ServingError("serving session is closed"))
+            except Exception:  # noqa: BLE001 - lost the race: it completed
+                pass
+        return fut
+
+    def predict(self, rows, timeout: Optional[float] = None) -> np.ndarray:
+        """Synchronous :meth:`predict_async`."""
+        return self.predict_async(rows).result(timeout=timeout)
+
+    def serving_report(self) -> Dict[str, Any]:
+        """Counters + latency snapshot (the ``shuffle_stage_report`` twin
+        for the serving plane; columns documented in doc/serving.md)."""
+        if self._closed and not self._dispatcher.is_alive():
+            return self._report()  # post-close snapshot: nothing mutates
+        done: Future = Future()
+        self._events.put(("report", done))
+        return done.result(timeout=30.0)
+
+    def close(self, unload: bool = True) -> None:
+        """Stop the dispatcher; in-flight work is failed, replicas unloaded
+        (``unload=False`` keeps them for a successor session)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._events.put(("stop",))
+        self._dispatcher.join(timeout=30.0)
+        if unload:
+            for rep in self._replicas:
+                try:
+                    rep.replica.call("serve_unload", rep.rid, timeout=10.0)
+                except Exception:  # noqa: BLE001 - executor may be gone
+                    pass
+
+    def __enter__(self) -> "ServingSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- dispatcher internals (single thread) -------------------------------
+    def _run(self) -> None:
+        while True:
+            timeout = self._next_wakeup()
+            try:
+                ev = self._events.get(timeout=timeout)
+            except queue.Empty:
+                ev = None
+            try:
+                if ev is not None:
+                    kind = ev[0]
+                    if kind == "stop":
+                        self._drain_stop()
+                        return
+                    if kind == "req":
+                        self._on_request(ev[1])
+                    elif kind == "done":
+                        self._on_done(ev[1], ev[2], ev[3], ev[4])
+                    elif kind == "replica_up":
+                        self._on_replica_up(ev[1], ev[2])
+                    elif kind == "report":
+                        ev[1].set_result(self._report())
+                self._flush_batches()
+                self._maybe_hedge()
+                self._retry_parked()
+            except Exception:  # noqa: BLE001 - the loop must survive anything
+                # a dead dispatcher bricks every current and future request;
+                # per-batch/per-dispatch errors are already routed to their
+                # own futures, so whatever reaches here is a bug to log,
+                # never a reason to stop serving
+                logger.exception("serving dispatcher error (loop continues)")
+
+    def _next_wakeup(self) -> Optional[float]:
+        """Sleep until the earliest deadline the loop owns: the oldest
+        pending batch's flush, or the next hedge-eligibility instant."""
+        deadlines = []
+        if self._pending:
+            deadlines.append(self._pending[0].t_enq + self._timeout_s)
+        hedge_after = self._hedge_deadline()
+        if hedge_after is not None:
+            for d in self._inflight.values():
+                if not d.hedged and not d.done:
+                    deadlines.append(d.t_first + hedge_after)
+        if self._parked:
+            deadlines.append(time.monotonic() + 0.05)
+        if not deadlines:
+            return None
+        return max(0.0, min(deadlines) - time.monotonic()) or 0.001
+
+    # -- batching -------------------------------------------------------------
+    def _on_request(self, req: _Request) -> None:
+        self._stats["requests"] += 1
+        self._pending.append(req)
+        self._pending_rows += req.rows
+        self._queue_depth_peak = max(
+            self._queue_depth_peak, len(self._pending) + len(self._inflight))
+
+    def _flush_batches(self) -> None:
+        while self._pending:
+            full = self._pending_rows >= self._max_batch
+            aged = (time.monotonic() - self._pending[0].t_enq
+                    >= self._timeout_s)
+            if not (full or aged):
+                return
+            # coalesce only schema-equal requests: a mixed batch would fail
+            # pa.concat_tables and punish the well-formed requests packed
+            # with it; the other-schema requests stay pending and form
+            # their own batch on a later pass of this loop
+            schema = self._pending[0].table.schema
+            batch: List[_Request] = []
+            rows = 0
+            rest: List[_Request] = []
+            for r in self._pending:
+                if (batch and rows + r.rows > self._max_batch) \
+                        or not r.table.schema.equals(schema):
+                    rest.append(r)
+                    continue
+                batch.append(r)
+                rows += r.rows
+            self._pending = rest
+            self._pending_rows -= rows
+            self._dispatch_new(batch, rows)
+
+    def _dispatch_new(self, batch: List[_Request], rows: int) -> None:
+        parts, off = [], 0
+        for r in batch:
+            parts.append((r, off))
+            off += r.rows
+        try:
+            table = (batch[0].table if len(batch) == 1
+                     else pa.concat_tables([r.table for r in batch]))
+            payload = _encode(table)
+        except Exception as e:  # noqa: BLE001 - a bad request fails fast
+            self._stats["failed"] += len(batch)
+            for r in batch:
+                if not r.fut.done():
+                    r.fut.set_exception(e)
+            return
+        d = _Dispatch(next(self._did), payload, rows, parts)
+        self._stats["batches"] += 1
+        self._stats["rows"] += rows
+        self._occupancy.append(rows)
+        if len(self._occupancy) > _LAT_WINDOW:
+            del self._occupancy[:-_LAT_WINDOW]
+        self._submit(d, hedge=False)
+
+    # -- routing --------------------------------------------------------------
+    def _choose(self, d: _Dispatch) -> Optional[_ReplicaState]:
+        """Least-busy ready replica not already carrying this dispatch,
+        round-robin on ties, respecting the per-replica in-flight cap —
+        except when EVERY ready replica is at cap, where the least-busy one
+        is taken anyway (a serving request must queue, not park forever)."""
+        start = next(self._rr)
+        k = len(self._replicas)
+        best = None
+        for allow_full in (False, True):
+            for i in range(k):
+                rep = self._replicas[(start + i) % k]
+                if not rep.ready or rep.rid in d.tried:
+                    continue
+                if not allow_full and rep.inflight >= self._max_inflight:
+                    continue
+                if best is None or rep.inflight < best.inflight:
+                    best = rep
+            if best is not None:
+                return best
+        return None
+
+    def _submit(self, d: _Dispatch, hedge: bool) -> bool:
+        """Route and send one attempt; True only when an attempt is
+        actually in flight (the hedge accounting keys on it)."""
+        rep = self._choose(d)
+        if rep is None:
+            if hedge:
+                return False  # no second replica free: simply do not hedge
+            self._park(d)
+            return False
+        d.tried.add(rep.rid)
+        t0 = time.monotonic()
+        span = "serve:hedge" if hedge else "serve:batch"
+        try:
+            # the span covers the driver-side submit (encode happened at
+            # coalesce time); the replica-side serve:apply span carries the
+            # device half of the timeline
+            with profiler.trace(span, "serve", replica=rep.rid,
+                                rows=d.rows, requests=len(d.parts)):
+                replica = rep.replica
+                fut = replica.submit("serve_predict", rep.rid, d.payload)
+        except (ConnectionLost, OSError) as e:
+            # the executor is unreachable (restarting): take the replica out
+            # of rotation, start its background reload, and re-route
+            self._note_replica_error(_Attempt(rep, t0, hedge), e)
+            self._attempt_failed(d, rep, e)
+            return False
+        rep.inflight += 1
+        rep.inflight_peak = max(rep.inflight_peak, rep.inflight)
+        rep.batches += 1
+        rep.requests += len(d.parts)
+        rep.rows += d.rows
+        if hedge:
+            rep.hedges += 1
+        aid = id(fut)
+        d.attempts[aid] = _Attempt(rep, t0, hedge)
+        self._inflight[d.id] = d
+
+        def _cb(f, did=d.id, aid=aid, rid=rep.rid):
+            # client read-loop thread: enqueue only, never block
+            self._events.put(("done", did, aid, rid, f))
+
+        fut.add_done_callback(_cb)
+        return True
+
+    def _park(self, d: _Dispatch) -> None:
+        """No routable replica right now (all restarting/reloading): hold
+        the dispatch and retry as replicas come back, up to the grace."""
+        if time.monotonic() - d.t_first > self._reroute_grace_s:
+            self._fail_dispatch(d)
+            return
+        if d not in self._parked:
+            # a parked dispatch may be re-tried on any replica again once
+            # one reloads — a reloaded replica is a FRESH process
+            d.tried.clear()
+            self._parked.append(d)
+        # parked work is the strongest signal a dead replica is still
+        # needed: re-kick any reload that previously gave up, so a
+        # transient full outage longer than one reload pass does not brick
+        # the session for its remaining lifetime
+        for rep in self._replicas:
+            if not rep.ready and not rep.reloading:
+                rep.reloading = True
+                threading.Thread(target=self._reload, args=(rep,),
+                                 daemon=True,
+                                 name=f"rdt-serve-reload-{rep.rid}").start()
+
+    def _retry_parked(self) -> None:
+        if not self._parked:
+            return
+        parked, self._parked = self._parked, []
+        for d in parked:
+            if not d.done:
+                self._submit(d, hedge=False)
+
+    # -- completion / hedging / fault path ------------------------------------
+    def _on_done(self, did: int, aid: int, rid: str, fut: Future) -> None:
+        d = self._inflight.get(did)
+        if d is None:
+            return
+        att = d.attempts.pop(aid, None)
+        if att is not None:
+            att.replica.inflight = max(0, att.replica.inflight - 1)
+        err = fut.exception()
+        if d.done:
+            # the loser of a won hedge (or of a rescue): discard, count
+            if err is None and att is not None:
+                self._stats["hedge_lost"] += 1
+            if not d.attempts:
+                self._inflight.pop(did, None)
+            if err is not None:
+                self._note_replica_error(att, err)
+            return
+        if err is None:
+            d.done = True
+            if att is not None and att.hedge:
+                self._stats["hedge_won"] += 1
+            now = time.monotonic()
+            if att is not None:
+                self._batch_lat.append(now - att.t0)
+                if len(self._batch_lat) > _LAT_WINDOW:
+                    del self._batch_lat[:-_LAT_WINDOW]
+            preds = np.asarray(fut.result())
+            for req, off in d.parts:
+                if not req.fut.done():  # close()/race-failed futures skip
+                    req.fut.set_result(preds[off:off + req.rows])
+                self._req_lat.append(now - req.t_enq)
+            if len(self._req_lat) > _LAT_WINDOW:
+                del self._req_lat[:-_LAT_WINDOW]
+            if not d.attempts:
+                self._inflight.pop(did, None)
+            return
+        # failed attempt
+        self._note_replica_error(att, err)
+        self._attempt_failed(d, att.replica if att else None, err)
+
+    def _attempt_failed(self, d: _Dispatch, rep: Optional[_ReplicaState],
+                        err: BaseException) -> None:
+        d.last_error = err
+        if d.attempts:
+            return  # a sibling copy is still racing; it may still win
+        if not _reroutable(err):
+            # deterministic application error (bad schema, model bug):
+            # another replica would compute the same failure — fail the
+            # request now instead of burning the re-route grace on it
+            self._fail_dispatch(d)
+            return
+        if time.monotonic() - d.t_first > self._reroute_grace_s:
+            self._fail_dispatch(d)
+            return
+        self._stats["rerouted"] += 1
+        logger.warning("serve dispatch %d re-routing off %s after: %s",
+                       d.id, rep.rid if rep else "?", err)
+        self._submit(d, hedge=False)
+
+    def _fail_dispatch(self, d: _Dispatch) -> None:
+        d.done = True
+        self._inflight.pop(d.id, None)
+        self._stats["failed"] += len(d.parts)
+        err = ServingError(
+            f"request failed on every replica within "
+            f"{self._reroute_grace_s:.0f}s (last error: {d.last_error})")
+        err.__cause__ = d.last_error
+        for req, _ in d.parts:
+            if not req.fut.done():
+                req.fut.set_exception(err)
+
+    def _note_replica_error(self, att: Optional[_Attempt],
+                            err: BaseException) -> None:
+        """Infra errors take the replica out of rotation and start a
+        background reload; app errors (a bad request) leave it serving."""
+        if att is None:
+            return
+        rep = att.replica
+        not_loaded = (isinstance(err, RemoteError)
+                      and err.exc_type == "ReplicaNotLoaded")
+        if not (isinstance(err, ConnectionLost) or not_loaded):
+            return
+        if rep.reloading:
+            return
+        rep.ready = False
+        rep.reloading = True
+        threading.Thread(target=self._reload, args=(rep,), daemon=True,
+                         name=f"rdt-serve-reload-{rep.rid}").start()
+
+    def _reload(self, rep: _ReplicaState) -> None:
+        """Background: wait out the executor restart and reload the
+        servable, then hand the replica back to the dispatcher."""
+        deadline = time.monotonic() + self._reroute_grace_s
+        last: Optional[BaseException] = None
+        while time.monotonic() < deadline:
+            if self._closed:
+                return  # session gone: stop dialing a stopped runtime
+            try:
+                replica = rep.replica
+                replica.call("serve_load", rep.rid, self.export_dir,
+                             timeout=60.0)
+                self._events.put(("replica_up", rep, None))
+                return
+            except Exception as e:  # noqa: BLE001 - keep probing the restart
+                last = e
+                time.sleep(0.5)
+        logger.error("replica %s did not come back within %.0fs: %s",
+                     rep.rid, self._reroute_grace_s, last)
+        self._events.put(("replica_up", rep, last))
+
+    def _on_replica_up(self, rep: _ReplicaState,
+                       err: Optional[BaseException]) -> None:
+        rep.reloading = False
+        if err is None:
+            rep.ready = True
+            rep.reloads += 1
+            rep.inflight = 0
+            logger.info("replica %s reloaded and back in rotation", rep.rid)
+
+    # -- hedging --------------------------------------------------------------
+    def _hedge_deadline(self) -> Optional[float]:
+        """Seconds after which an in-flight dispatch earns a hedge, or None
+        while hedging is off / unwarmed / pointless (a single replica)."""
+        if not self._hedge_on or len(self._replicas) < 2:
+            return None
+        if len(self._batch_lat) < _HEDGE_MIN_SAMPLES:
+            return None
+        return max(self._hedge_mult * _quantile(self._batch_lat,
+                                                self._hedge_q),
+                   self._hedge_min_s)
+
+    def _maybe_hedge(self) -> None:
+        deadline = self._hedge_deadline()
+        if deadline is None:
+            return
+        now = time.monotonic()
+        for d in list(self._inflight.values()):
+            if d.done or d.hedged or not d.attempts:
+                continue
+            if now - d.t_first >= deadline:
+                # count (and retire) the hedge only once it is really in
+                # flight: with the sibling replica reloading/at-fault the
+                # dispatch stays eligible and retries on a later tick
+                if self._submit(d, hedge=True):
+                    d.hedged = True
+                    self._stats["hedged"] += 1
+
+    # -- reporting / teardown -------------------------------------------------
+    def _report(self) -> Dict[str, Any]:
+        lat = sorted(self._req_lat)
+        occ = self._occupancy
+        out = dict(self._stats)
+        out.update({
+            "p50_ms": round(_quantile(lat, 0.50) * 1000.0, 3),
+            "p99_ms": round(_quantile(lat, 0.99) * 1000.0, 3),
+            "mean_batch_occupancy": (round(sum(occ) / len(occ), 2)
+                                     if occ else 0.0),
+            "max_batch_occupancy": max(occ) if occ else 0,
+            "queue_depth": len(self._pending) + len(self._inflight),
+            "queue_depth_peak": self._queue_depth_peak,
+            "replicas": [{
+                "replica": r.rid,
+                "executor": r.executor,
+                "ready": r.ready,
+                "requests": r.requests,
+                "batches": r.batches,
+                "rows": r.rows,
+                "hedges": r.hedges,
+                "inflight": r.inflight,
+                "inflight_peak": r.inflight_peak,
+                "reloads": r.reloads,
+            } for r in self._replicas],
+        })
+        return out
+
+    def _drain_stop(self) -> None:
+        err = ServingError("serving session closed with requests in flight")
+        for req in self._pending:
+            if not req.fut.done():
+                req.fut.set_exception(err)
+        self._pending = []
+        for d in list(self._inflight.values()) + self._parked:
+            if not d.done:
+                for req, _ in d.parts:
+                    if not req.fut.done():
+                        req.fut.set_exception(err)
+        self._inflight.clear()
+        self._parked = []
+        # requests enqueued behind the stop event would otherwise hold
+        # futures nobody ever completes
+        while True:
+            try:
+                ev = self._events.get_nowait()
+            except queue.Empty:
+                break
+            if ev[0] == "req" and not ev[1].fut.done():
+                ev[1].fut.set_exception(err)
+            elif ev[0] == "report":
+                ev[1].set_result(self._report())

@@ -82,6 +82,10 @@ RPC_RECEIVER_SURFACES = {
     "stub": "worker",
     "handle": "*",
     "client": "*",
+    # the serving plane's replica handles (serve/session.py) are executor
+    # actors: serve_* call sites resolve strictly against the actor surface
+    "replica": "actor",
+    "_replica": "actor",
 }
 
 #: actor-runtime intrinsics served by ``_ActorServer.__call__`` BEFORE the

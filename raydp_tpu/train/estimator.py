@@ -18,6 +18,15 @@ class EstimatorInterface(ABC):
     def get_model(self):
         ...
 
+    def export_serving(self, export_dir: str) -> str:
+        """Write a self-contained serving bundle (weights through
+        ``train/checkpoint.py`` + the pickled inference recipe) that
+        :class:`raydp_tpu.serve.ServingSession` loads onto executor
+        replicas. Implemented by the flax and keras estimators; others
+        (e.g. GBDT) have no jit-servable forward pass yet."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support export_serving()")
+
 
 class FrameEstimatorInterface(ABC):
     """``fit_on_frame`` — the ``fit_on_spark`` analogue

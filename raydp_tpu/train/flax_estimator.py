@@ -884,6 +884,37 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
             return np.empty((0,), np.float32)
         return np.concatenate(out, axis=0)
 
+    # --------------------------------------------------------- export_serving
+    def export_serving(self, export_dir: str) -> str:
+        """Write a serving bundle for :class:`raydp_tpu.serve.ServingSession`:
+        the trained variables through ``train/checkpoint.py`` plus the
+        pickled inference recipe (model, column spec, preprocessor, cast
+        policy) — exactly what :meth:`predict` uses, so a replica's output
+        is row-identical to a driver-side ``predict()`` on the same rows.
+        Multi-host executor pools need ``export_dir`` on shared storage (the
+        gang-checkpoint contract)."""
+        from raydp_tpu.serve.servable import export_bundle
+
+        model = self._build_model()
+        variables = self.get_model()   # raises if fit() has not run
+        custom = (self.batch_preprocessor is not None
+                  or self.columns_spec is not None)
+        # non-custom models consume only "features"; the custom path ships
+        # the full spec and the replica synthesizes absent entries (the
+        # label) as zeros, like predict()
+        columns = (dict(self._columns()) if custom
+                   else {"features": (self.feature_columns,
+                                      self.feature_dtype)})
+        bundle = {
+            "model": model,
+            "columns": columns,
+            "custom": custom,
+            "preprocessor": self.batch_preprocessor,
+            "compute_dtype": self.compute_dtype,
+            "takes_train": _takes_train(model),
+        }
+        return export_bundle(export_dir, "flax", bundle, variables)
+
     # -------------------------------------------------------------- get_model
     def get_model(self):
         """Trained Flax variables (parity: get_model from checkpoint,

@@ -960,6 +960,28 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
             raise RuntimeError("call fit()/fit_on_frame() first")
         return self._trained_model
 
+    # --------------------------------------------------------- export_serving
+    def export_serving(self, export_dir: str) -> str:
+        """Serving-bundle export, keras flavor: the trained
+        trainable/non-trainable variable lists go through
+        ``train/checkpoint.py`` (they are what ``stateless_call`` consumes —
+        the restored checkpoint is the weight truth; the pickled model
+        object only contributes the architecture), plus the feature-column
+        spec :meth:`predict` uses."""
+        from raydp_tpu.serve.servable import export_bundle
+
+        model = self.get_model()   # raises if fit() has not run
+        state = {
+            "tv": [np.asarray(v) for v in model.trainable_variables],
+            "ntv": [np.asarray(v) for v in model.non_trainable_variables],
+        }
+        bundle = {
+            "model": model,
+            "columns": {"features": (self.feature_columns,
+                                     self.feature_dtype)},
+        }
+        return export_bundle(export_dir, "keras", bundle, state)
+
     # ---------------------------------------------------------------- predict
     def predict(self, ds, batch_size: Optional[int] = None) -> np.ndarray:
         """Predictions over a dataset's feature columns as one host array

@@ -125,9 +125,11 @@ MEASURED_CLAIM_FILES = [
     "benchmarks/gang_collective_microbench.py",
     "benchmarks/host_decode_bench.py",
     "benchmarks/shuffle_bench.py",
+    "benchmarks/serve_bench.py",
     "bench.py",
     "doc/training.md",
     "doc/etl.md",
+    "doc/serving.md",
     "README.md",
 ]
 
@@ -141,7 +143,8 @@ _MEASURED_RE = re.compile(
     r"|samples/s(?:/chip)?|ms/step|×\s*fewer\s+shuffled\s+bytes"
     r"|×\s*fewer\s+store\s+metadata\s+RPCs"
     r"|×\s*fewer\s+reduce\s+dispatches"
-    r"|×\s*faster\s+stage\s+wall))",
+    r"|×\s*faster\s+stage\s+wall"
+    r"|×\s*lower\s+p99(?:\s+latency)?))",
     re.I)
 
 

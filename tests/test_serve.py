@@ -1,0 +1,541 @@
+"""Serving-plane tests (ISSUE 11).
+
+Two layers, mirroring how the plane is built:
+
+- **dispatcher units** — :class:`ServingSession`'s micro-batching, demux,
+  routing, hedging, and fault re-route driven against in-process fake
+  replica handles (no actors, no jax): fast, deterministic, and able to
+  script failure shapes no real schedule can time reliably.
+- **integration** — a real 2-executor session: estimator fit → export →
+  executor-resident replicas, with the coalesced results asserted
+  BIT-identical to the estimator's own ``predict`` (the jitted apply is
+  row-independent, so batch composition must not leak into results).
+
+The replica-crash chaos leg lives in tests/test_chaos.py with the other
+seeded-injection coverage.
+"""
+
+import os
+import threading
+import time
+from concurrent.futures import Future
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from raydp_tpu.runtime.rpc import ConnectionLost, RemoteError
+from raydp_tpu.serve import ServingError, ServingSession
+from raydp_tpu.serve.session import _as_table
+
+
+# ---------------------------------------------------------------------------
+# fake replica handles: duck-typed ActorHandles serving 2*v in-process
+# ---------------------------------------------------------------------------
+
+def _decode_payload(payload: bytes) -> pa.Table:
+    return pa.ipc.open_stream(pa.py_buffer(payload)).read_all()
+
+
+class FakeReplicaHandle:
+    """Serves ``2 * v`` per row on a thread after ``delay_s()`` seconds;
+    ``fail`` scripts an infrastructure failure per call, ``app_fail`` a
+    deterministic application error (a remote ValueError)."""
+
+    def __init__(self, name, delay_s=0.0, fail: bool = False,
+                 app_fail: bool = False, fail_delay_s: float = 0.01):
+        self.name = name
+        self.delay_s = delay_s
+        self.fail = fail
+        self.app_fail = app_fail
+        self.fail_delay_s = fail_delay_s
+        self.loads = 0
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def call(self, method, *args, timeout=None, **kwargs):
+        if method == "serve_load":
+            with self._lock:
+                self.loads += 1
+            return {"replica": args[0]}
+        if method == "serve_unload":
+            return True
+        raise AssertionError(f"unexpected call {method}")
+
+    def submit(self, method, *args, **kwargs):
+        fut: Future = Future()
+        if method == "serve_load":
+            with self._lock:
+                self.loads += 1
+            fut.set_result({"replica": args[0]})
+            return fut
+        assert method == "serve_predict"
+        _rid, payload = args
+        with self._lock:
+            self.calls += 1
+        threading.Thread(target=self._serve, args=(payload, fut),
+                         daemon=True).start()
+        return fut
+
+    def _serve(self, payload, fut):
+        if self.fail:
+            time.sleep(self.fail_delay_s)
+            fut.set_exception(ConnectionLost(f"{self.name} is scripted down"))
+            return
+        if self.app_fail:
+            time.sleep(self.fail_delay_s)
+            fut.set_exception(RemoteError("ValueError", "bad rows", "<tb>"))
+            return
+        d = self.delay_s() if callable(self.delay_s) else self.delay_s
+        if d:
+            time.sleep(d)
+        table = _decode_payload(payload)
+        v = table.column("v").to_numpy(zero_copy_only=False)
+        fut.set_result((v * 2.0).astype(np.float32))
+
+
+def _serving(replicas, monkeypatch, *, max_batch=1000, timeout_ms=40.0,
+             hedge=False, hedge_mult=2.0, hedge_min_ms=50.0,
+             grace_s=10.0, inflight=2):
+    monkeypatch.setenv("RDT_SERVE_MAX_BATCH", str(max_batch))
+    monkeypatch.setenv("RDT_SERVE_BATCH_TIMEOUT_MS", str(timeout_ms))
+    monkeypatch.setenv("RDT_SERVE_HEDGE", "1" if hedge else "0")
+    monkeypatch.setenv("RDT_SERVE_HEDGE_QUANTILE", "0.5")
+    monkeypatch.setenv("RDT_SERVE_HEDGE_MULTIPLIER", str(hedge_mult))
+    monkeypatch.setenv("RDT_SERVE_HEDGE_MIN_MS", str(hedge_min_ms))
+    monkeypatch.setenv("RDT_SERVE_REROUTE_GRACE_S", str(grace_s))
+    monkeypatch.setenv("RDT_SERVE_MAX_INFLIGHT", str(inflight))
+    return ServingSession("/nonexistent/bundle", executors=replicas,
+                          name="t")
+
+
+def _rows(*vals):
+    return {"v": np.asarray(vals, np.float64)}
+
+
+def test_as_table_accepts_frames_tables_dicts():
+    t = _as_table(pa.table({"v": [1.0]}))
+    assert t.num_rows == 1
+    t = _as_table(pd.DataFrame({"v": [1.0, 2.0]}))
+    assert t.num_rows == 2
+    t = _as_table({"v": np.array([3.0])})
+    assert t.num_rows == 1
+    with pytest.raises(TypeError):
+        _as_table([1, 2, 3])
+
+
+def test_coalescing_batches_and_demuxes(monkeypatch):
+    """A burst of single-row requests coalesces into far fewer dispatches,
+    and every caller gets exactly its own row back."""
+    fakes = [FakeReplicaHandle("a", delay_s=0.02),
+             FakeReplicaHandle("b", delay_s=0.02)]
+    srv = _serving(fakes, monkeypatch, timeout_ms=40.0)
+    try:
+        futs = [srv.predict_async(_rows(float(i))) for i in range(64)]
+        got = [f.result(timeout=30.0) for f in futs]
+        for i, g in enumerate(got):
+            assert g.shape == (1,)
+            assert g[0] == np.float32(2.0 * i)
+        rep = srv.serving_report()
+        assert rep["requests"] == 64
+        assert rep["batches"] < 64          # coalescing actually happened
+        assert rep["rows"] == 64
+        assert rep["mean_batch_occupancy"] > 1.0
+        assert rep["failed"] == 0
+    finally:
+        srv.close()
+
+
+def test_timeout_flushes_a_lone_request(monkeypatch):
+    """A single request never waits for a batch to fill: the latency budget
+    flushes it."""
+    srv = _serving([FakeReplicaHandle("a")], monkeypatch,
+                   max_batch=100000, timeout_ms=30.0)
+    try:
+        t0 = time.monotonic()
+        out = srv.predict(_rows(21.0), timeout=30.0)
+        wall = time.monotonic() - t0
+        assert out[0] == np.float32(42.0)
+        assert wall < 5.0
+        rep = srv.serving_report()
+        assert rep["batches"] == 1 and rep["max_batch_occupancy"] == 1
+    finally:
+        srv.close()
+
+
+def test_full_batch_dispatches_before_timeout(monkeypatch):
+    """Hitting the row cap flushes immediately — the budget is a ceiling,
+    not a tax on full batches."""
+    fake = FakeReplicaHandle("a")
+    srv = _serving([fake], monkeypatch, max_batch=8, timeout_ms=60_000.0)
+    try:
+        futs = [srv.predict_async(_rows(float(i))) for i in range(8)]
+        t0 = time.monotonic()
+        for f in futs:
+            f.result(timeout=30.0)
+        assert time.monotonic() - t0 < 10.0  # nowhere near the 60s budget
+    finally:
+        srv.close()
+
+
+def test_oversized_request_is_its_own_batch(monkeypatch):
+    """A request above RDT_SERVE_MAX_BATCH dispatches alone, un-split."""
+    srv = _serving([FakeReplicaHandle("a")], monkeypatch, max_batch=4,
+                   timeout_ms=10.0)
+    try:
+        vals = np.arange(10, dtype=np.float64)
+        out = srv.predict({"v": vals}, timeout=30.0)
+        assert np.array_equal(out, (vals * 2).astype(np.float32))
+        rep = srv.serving_report()
+        assert rep["max_batch_occupancy"] == 10
+    finally:
+        srv.close()
+
+
+def test_demux_ordering_under_interleaved_threads(monkeypatch):
+    """Requests issued from many threads each get their own rows, in their
+    own order, regardless of how the dispatcher packed them."""
+    fakes = [FakeReplicaHandle("a", delay_s=0.01),
+             FakeReplicaHandle("b", delay_s=0.01)]
+    srv = _serving(fakes, monkeypatch, timeout_ms=20.0)
+    errors = []
+
+    def client(base):
+        try:
+            vals = np.array([base, base + 0.25, base + 0.5])
+            out = srv.predict({"v": vals}, timeout=30.0)
+            assert np.array_equal(out, (vals * 2).astype(np.float32))
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=client, args=(float(i),))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not errors
+        rep = srv.serving_report()
+        assert rep["requests"] == 16 and rep["rows"] == 48
+    finally:
+        srv.close()
+
+
+def test_routing_spreads_over_replicas(monkeypatch):
+    fakes = [FakeReplicaHandle("a"), FakeReplicaHandle("b")]
+    srv = _serving(fakes, monkeypatch, max_batch=1, timeout_ms=0.0)
+    try:
+        for i in range(10):
+            srv.predict(_rows(float(i)), timeout=30.0)
+        rep = srv.serving_report()
+        per = {r["replica"]: r["batches"] for r in rep["replicas"]}
+        assert all(n >= 1 for n in per.values()), per
+    finally:
+        srv.close()
+
+
+def test_hedging_wins_and_accounts(monkeypatch):
+    """A replica that turns slow after warmup gets hedged: the fast sibling
+    answers, the request never waits out the straggler, and the counters
+    record the race both ways."""
+    slow_after = {"n": 0}
+
+    def a_delay():
+        slow_after["n"] += 1
+        return 0.0 if slow_after["n"] <= 8 else 1.5
+
+    fakes = [FakeReplicaHandle("a", delay_s=a_delay),
+             FakeReplicaHandle("b", delay_s=0.0)]
+    srv = _serving(fakes, monkeypatch, max_batch=1, timeout_ms=0.0,
+                   hedge=True, hedge_mult=2.0, hedge_min_ms=50.0)
+    try:
+        # warmup: sequential requests alternate replicas, recording >= 8
+        # fast batch latencies (the hedge-eligibility floor)
+        for i in range(16):
+            srv.predict(_rows(float(i)), timeout=30.0)
+        # now replica a is a straggler: every request it receives should
+        # hedge onto b and complete far below a's 1.5s delay
+        t0 = time.monotonic()
+        futs = [srv.predict_async(_rows(100.0 + i)) for i in range(4)]
+        got = [f.result(timeout=30.0) for f in futs]
+        wall = time.monotonic() - t0
+        for i, g in enumerate(got):
+            assert g[0] == np.float32(2.0 * (100.0 + i))
+        assert wall < 1.4, f"hedging did not cut the straggler tail: {wall}"
+        rep = srv.serving_report()
+        assert rep["hedged"] >= 1
+        assert rep["hedge_won"] >= 1
+        assert rep["failed"] == 0
+        # the losers land ~1.5s later and are discarded+counted
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            rep = srv.serving_report()
+            if rep["hedge_lost"] >= 1:
+                break
+            time.sleep(0.1)
+        assert rep["hedge_lost"] >= 1
+    finally:
+        srv.close()
+
+
+def test_failed_replica_reroutes_and_reloads(monkeypatch):
+    """Every request that lands on the scripted-down replica re-routes to
+    the live one; the dead replica's background reload is attempted."""
+    down = FakeReplicaHandle("a", fail=True)
+    up = FakeReplicaHandle("b")
+    srv = _serving([down, up], monkeypatch, max_batch=1, timeout_ms=0.0)
+    try:
+        for i in range(6):
+            out = srv.predict(_rows(float(i)), timeout=30.0)
+            assert out[0] == np.float32(2.0 * i)
+        rep = srv.serving_report()
+        assert rep["failed"] == 0
+        assert rep["rerouted"] >= 1          # some requests hit the down one
+        assert down.loads >= 2               # initial load + reload attempt
+    finally:
+        srv.close()
+
+
+def test_app_error_fails_fast_without_reroute(monkeypatch):
+    """A deterministic application error (a remote ValueError) must fail
+    the request immediately — replaying it on the sibling replica would
+    replay the error, and burning the 30s re-route grace on it is the
+    failure mode doc/serving.md's table rules out."""
+    srv = _serving([FakeReplicaHandle("a", app_fail=True),
+                    FakeReplicaHandle("b", app_fail=True)],
+                   monkeypatch, max_batch=1, timeout_ms=0.0, grace_s=30.0)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(ServingError) as ei:
+            srv.predict(_rows(1.0), timeout=30.0)
+        assert time.monotonic() - t0 < 5.0
+        assert "ValueError" in str(ei.value)
+        rep = srv.serving_report()
+        assert rep["rerouted"] == 0       # never bounced between replicas
+    finally:
+        srv.close()
+
+
+def test_mixed_schemas_coalesce_separately(monkeypatch):
+    """Requests with different schemas in one batching window dispatch as
+    separate batches — a mixed concat would fail and punish well-formed
+    requests (and, pre-fix, killed the dispatcher thread outright)."""
+    srv = _serving([FakeReplicaHandle("a")], monkeypatch, timeout_ms=40.0)
+    try:
+        f1 = srv.predict_async({"v": np.array([1.0]),
+                                "extra": np.array([9.0])})
+        f2 = srv.predict_async(_rows(2.0))
+        assert f2.result(timeout=30.0)[0] == np.float32(4.0)
+        assert f1.result(timeout=30.0)[0] == np.float32(2.0)
+        # the session survives and keeps serving
+        assert srv.predict(_rows(3.0), timeout=30.0)[0] == np.float32(6.0)
+    finally:
+        srv.close()
+
+
+def test_every_replica_down_fails_within_grace(monkeypatch):
+    srv = _serving([FakeReplicaHandle("a", fail=True),
+                    FakeReplicaHandle("b", fail=True)],
+                   monkeypatch, max_batch=1, timeout_ms=0.0, grace_s=1.0)
+    try:
+        with pytest.raises(ServingError):
+            srv.predict(_rows(1.0), timeout=30.0)
+        rep = srv.serving_report()
+        assert rep["failed"] >= 1
+    finally:
+        srv.close()
+
+
+def test_report_columns(monkeypatch):
+    srv = _serving([FakeReplicaHandle("a")], monkeypatch)
+    try:
+        srv.predict(_rows(1.0), timeout=30.0)
+        rep = srv.serving_report()
+        for col in ("requests", "batches", "rows", "p50_ms", "p99_ms",
+                    "mean_batch_occupancy", "max_batch_occupancy",
+                    "queue_depth", "queue_depth_peak", "hedged",
+                    "hedge_won", "hedge_lost", "rerouted", "failed",
+                    "replicas"):
+            assert col in rep, col
+        assert rep["p99_ms"] >= rep["p50_ms"] >= 0.0
+        r0 = rep["replicas"][0]
+        for col in ("replica", "executor", "ready", "requests", "batches",
+                    "rows", "hedges", "inflight", "inflight_peak",
+                    "reloads"):
+            assert col in r0, col
+    finally:
+        srv.close()
+
+
+def test_closed_session_refuses_and_empty_request_shortcuts(monkeypatch):
+    srv = _serving([FakeReplicaHandle("a")], monkeypatch)
+    out = srv.predict(_rows(), timeout=5.0)   # 0 rows: answered inline
+    assert out.shape == (0,)
+    srv.close()
+    with pytest.raises(ServingError):
+        srv.predict_async(_rows(1.0))
+    # post-close report still answers (snapshot, no dispatcher)
+    assert "requests" in srv.serving_report()
+
+
+# ---------------------------------------------------------------------------
+# integration: real executors, real estimator, real bundles
+# ---------------------------------------------------------------------------
+
+def _linear_data(n=256):
+    rng = np.random.RandomState(3)
+    x = rng.random_sample((n, 2))
+    y = x @ np.array([2.0, -3.0]) + 1.0
+    return pd.DataFrame({"x1": x[:, 0], "x2": x[:, 1], "y": y})
+
+
+@pytest.fixture(scope="module")
+def served_model(tmp_path_factory):
+    """One 2-executor session + one trained/exported flax estimator shared
+    by the integration tests (executor-side jax import paid once)."""
+    import optax
+
+    import raydp_tpu
+    from raydp_tpu.models import MLP
+    from raydp_tpu.train import FlaxEstimator
+
+    s = raydp_tpu.init("serve_it", num_executors=2, executor_cores=1,
+                       executor_memory="512MB")
+    try:
+        pdf = _linear_data()
+        df = s.createDataFrame(pdf, num_partitions=2)
+        est = FlaxEstimator(
+            model=MLP(features=(8,), use_batch_norm=False),
+            optimizer=optax.adam(1e-2), loss="mse",
+            feature_columns=["x1", "x2"], label_column="y",
+            batch_size=64, num_epochs=1)
+        est.fit_on_frame(df)
+        export_dir = str(tmp_path_factory.mktemp("servable") / "flax")
+        est.export_serving(export_dir)
+        yield s, est, export_dir, pdf
+    finally:
+        raydp_tpu.stop()
+
+
+def test_flax_servable_roundtrip_matches_predict(served_model):
+    """load_servable() in-process reproduces estimator.predict bitwise on
+    the same rows."""
+    from raydp_tpu.data.dataset import from_frame
+    from raydp_tpu.serve import load_servable
+
+    s, est, export_dir, pdf = served_model
+    sv = load_servable(export_dir)
+    table = pa.table({"x1": pdf["x1"].values, "x2": pdf["x2"].values})
+    got = sv.predict_table(table)
+    df = s.createDataFrame(pdf, num_partitions=2)
+    ref = est.predict(from_frame(df.select("x1", "x2")))
+    assert np.array_equal(got, ref)
+
+
+def test_serving_session_row_identical_to_predict(served_model,
+                                                  monkeypatch):
+    """The acceptance matrix's core equality: concurrent coalesced serving
+    returns, per request, exactly the rows a driver-side predict computes —
+    coalescing must be invisible in the bits."""
+    from raydp_tpu.data.dataset import from_frame
+    from raydp_tpu.serve import ServingSession
+
+    s, est, export_dir, pdf = served_model
+    df = s.createDataFrame(pdf, num_partitions=2)
+    ref = est.predict(from_frame(df.select("x1", "x2")))
+
+    monkeypatch.setenv("RDT_SERVE_BATCH_TIMEOUT_MS", "20")
+    monkeypatch.setenv("RDT_SERVE_HEDGE", "0")
+    srv = ServingSession(export_dir, session=s, name="it")
+    try:
+        n = len(pdf)
+        futs = [srv.predict_async(
+            {"x1": pdf["x1"].values[i:i + 4], "x2": pdf["x2"].values[i:i + 4]})
+            for i in range(0, n, 4)]
+        got = np.concatenate([f.result(timeout=120.0) for f in futs])
+        assert np.array_equal(got, ref)
+        rep = srv.serving_report()
+        assert rep["requests"] == n // 4
+        assert rep["batches"] < rep["requests"]   # coalescing on real RPCs
+        assert rep["failed"] == 0
+        assert sum(r["batches"] for r in rep["replicas"]) == rep["batches"]
+    finally:
+        srv.close()
+
+
+def test_serve_stats_and_unload(served_model, monkeypatch):
+    from raydp_tpu.serve import ServingSession
+
+    s, _est, export_dir, pdf = served_model
+    monkeypatch.setenv("RDT_SERVE_HEDGE", "0")
+    srv = ServingSession(export_dir, session=s, name="stats")
+    try:
+        srv.predict({"x1": pdf["x1"].values[:8],
+                     "x2": pdf["x2"].values[:8]}, timeout=60.0)
+        stats = s.executors[0].call("serve_stats")
+        mine = [r for r in stats["replicas"]
+                if r["replica"].startswith("stats-")]
+        assert mine and mine[0]["model_nbytes"] > 0
+    finally:
+        srv.close()
+    # after close(unload=True) the replicas are gone from the registry
+    stats = s.executors[0].call("serve_stats")
+    assert not any(r["replica"].startswith("stats-")
+                   for r in stats["replicas"])
+
+
+def test_replica_not_loaded_is_typed(served_model):
+    s, _est, _export_dir, _pdf = served_model
+    with pytest.raises(RemoteError) as ei:
+        s.executors[0].call("serve_predict", "no-such-replica", b"")
+    assert ei.value.exc_type == "ReplicaNotLoaded"
+
+
+def test_keras_servable_roundtrip(served_model, tmp_path):
+    """Keras export → load_servable reproduces KerasEstimator.predict
+    bitwise (architecture from the pickled model, weights from the
+    checkpoint). Rides the shared session — init() is a singleton."""
+    keras = pytest.importorskip("keras")
+    from raydp_tpu.data.dataset import from_frame
+    from raydp_tpu.serve import load_servable
+    from raydp_tpu.train import KerasEstimator
+
+    s, _est, _export_dir, pdf = served_model
+    df = s.createDataFrame(pdf.iloc[:128], num_partitions=1)
+    model = keras.Sequential([
+        keras.layers.Input((2,)),
+        keras.layers.Dense(4, activation="relu"),
+        keras.layers.Dense(1),
+    ])
+    model.compile(optimizer="adam", loss="mse")
+    est = KerasEstimator(model=model, feature_columns=["x1", "x2"],
+                         label_column="y", batch_size=64, num_epochs=1)
+    est.fit_on_frame(df)
+    export_dir = str(tmp_path / "keras-bundle")
+    est.export_serving(export_dir)
+    sv = load_servable(export_dir)
+    got = sv.predict_table(
+        pa.table({"x1": pdf["x1"].values[:128], "x2": pdf["x2"].values[:128]}))
+    ref = est.predict(from_frame(df.select("x1", "x2")))
+    assert np.array_equal(got, ref)
+
+
+def test_export_requires_fit(tmp_path):
+    import optax
+
+    from raydp_tpu.models import MLP
+    from raydp_tpu.train import FlaxEstimator
+    from raydp_tpu.train.gbdt_estimator import GBDTEstimator
+
+    est = FlaxEstimator(model=MLP(features=(4,), use_batch_norm=False),
+                        optimizer=optax.adam(1e-2),
+                        feature_columns=["a"], label_column="b")
+    with pytest.raises(RuntimeError):
+        est.export_serving(str(tmp_path / "x"))
+    with pytest.raises(NotImplementedError):
+        GBDTEstimator(feature_columns=["a"],
+                      label_column="b").export_serving(str(tmp_path / "y"))
