@@ -1,0 +1,133 @@
+"""Trace smoke: the observability plane under seeded faults, end to end.
+
+Two phases over real 2-executor sessions (doc/observability.md):
+
+1. **Causal flows under recovery** — a seeded one-shot ``shuffle.write:drop``
+   forces a lineage-recovery round inside a groupagg action; the merged
+   chrome trace must contain (i) cross-process flow events linking a driver
+   span to an executor task span, and (ii) a ``recover:lineage`` span —
+   and the re-run's executor task spans — inside the failed read's action
+   trace.
+2. **Flight recorder** — an every-call drop defeats recovery
+   (``RDT_LINEAGE_ROUNDS=1``), the action surfaces ``StageError``, and the
+   postmortem ``blackbox-*.json`` bundle must carry the injected-fault,
+   object-loss, and recovery-round events.
+
+Run by the CI ``trace-smoke`` leg: ``python benchmarks/trace_smoke.py``.
+Asserts loudly; exit 0 is the pass signal. Everything writes under /tmp.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pandas as pd
+
+
+def _dataset(session, rows=4000):
+    return session.createDataFrame(pd.DataFrame(
+        {"k": np.arange(rows) % 7, "v": np.arange(float(rows))}))
+
+
+def phase_causal_flows(workdir: str) -> None:
+    os.environ["RDT_FAULTS"] = (
+        "shuffle.write:drop:nth=1:once="
+        + os.path.join(workdir, "drop.sentinel"))
+    import raydp_tpu
+    from raydp_tpu import profiler
+
+    session = raydp_tpu.init("trace-smoke", num_executors=2,
+                             executor_cores=1, executor_memory="512MB")
+    try:
+        out = _dataset(session).groupBy("k").sum("v").collect()
+        assert len(out) == 7, f"groupagg returned {len(out)} groups"
+        rep = [e for e in session.engine.shuffle_stage_report()
+               if e["regenerated"]]
+        assert rep, "the seeded drop did not trigger lineage recovery"
+        path = profiler.collect_chrome_trace(
+            os.path.join(workdir, "trace.json"))
+        assert path.skipped_actors == 0, \
+            f"{path.skipped_actors} actor lanes missing from the trace"
+    finally:
+        raydp_tpu.stop()
+
+    data = json.load(open(path))
+    evs = data["traceEvents"]
+    spans = [e for e in evs if e.get("ph") == "X"]
+    flows = [e for e in evs if e.get("cat") == "flow"]
+    # (i) >=1 cross-process flow event: a finish landing on an executor
+    # task span whose start sits in the driver lane
+    task_finishes = [
+        e for e in flows if e["ph"] == "f" and e["pid"] != 0
+        and any(s.get("pid") != 0 and str(s["name"]).startswith("task:")
+                and int(s["sid"], 16) == e["id"] for s in spans)]
+    assert task_finishes, "no flow event links a driver span to an " \
+        f"executor task span ({len(flows)} flow events total)"
+    # (ii) the recovery re-run links back into the failed action's trace
+    recov = [s for s in spans if s["name"] == "recover:lineage"]
+    assert recov, "no recover:lineage span in the merged trace"
+    tr = recov[0]["tr"]
+    assert any(s["name"] == "etl:action" and s["tr"] == tr for s in spans), \
+        "recover:lineage lost its action's trace id"
+    rerun = [s for s in spans if str(s["name"]).startswith("task:")
+             and s["pid"] != 0 and s["tr"] == tr
+             and s["ts"] >= recov[0]["ts"]]
+    assert rerun, "no re-run executor task span inside the action's trace"
+    print(f"phase 1 OK: {len(flows)} flow events "
+          f"({len(task_finishes)} driver→task), recovery re-run linked, "
+          f"offsets {path.clock_offsets_us}")
+
+
+def phase_flight_recorder(workdir: str) -> None:
+    os.environ["RDT_FAULTS"] = "shuffle.write:drop:every=1"
+    os.environ["RDT_LINEAGE_ROUNDS"] = "1"
+    import raydp_tpu
+    from raydp_tpu.etl.engine import StageError
+    from raydp_tpu.runtime import head as head_mod
+
+    session = raydp_tpu.init("bbox-smoke", num_executors=2,
+                             executor_cores=1, executor_memory="512MB")
+    try:
+        session_dir = head_mod.get_runtime().session_dir
+        failed = False
+        try:
+            _dataset(session, rows=1000).groupBy("k").sum("v").collect()
+        except StageError:
+            failed = True
+        assert failed, "the every-call drop did not fail the action"
+        bb_dir = os.path.join(session_dir, "blackbox")
+        bundles = sorted(f for f in os.listdir(bb_dir)
+                         if f.startswith("blackbox-")
+                         and f.endswith(".json"))
+        assert bundles, "failed action wrote no blackbox bundle"
+        bundle = json.load(open(os.path.join(bb_dir, bundles[0])))
+        kinds = {ev["kind"] for st in bundle["processes"].values()
+                 for ev in st.get("events", [])}
+        for want in ("fault_injected", "object_lost", "recovery_round",
+                     "action_failed"):
+            assert want in kinds, f"bundle missing {want!r} (has {kinds})"
+        assert bundle["skipped_processes"] == 0
+        print(f"phase 2 OK: {bundles[0]} carries {sorted(kinds)}")
+    finally:
+        raydp_tpu.stop()
+        os.environ.pop("RDT_FAULTS", None)
+        os.environ.pop("RDT_LINEAGE_ROUNDS", None)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    workdir = tempfile.mkdtemp(prefix="rdt-trace-smoke-")
+    phase_causal_flows(workdir)
+    phase_flight_recorder(workdir)
+    print("trace smoke passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

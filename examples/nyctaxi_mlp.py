@@ -38,6 +38,9 @@ def main():
     ap.add_argument("--num-workers", type=int, default=1,
                     help=">1 trains as a jax.distributed process gang")
     ap.add_argument("--csv", default=None)
+    ap.add_argument("--trace", action="store_true",
+                    help="collect a merged causal chrome trace + metrics "
+                         "dump before teardown (doc/observability.md)")
     args = ap.parse_args()
 
     csv_path = args.csv
@@ -70,6 +73,14 @@ def main():
                                         num_workers=args.num_workers)
         for row in result.history:
             print(row)
+        if args.trace:
+            # collect BEFORE teardown: dead actors' span lanes are lost
+            from raydp_tpu import metrics, profiler
+            path = profiler.collect_chrome_trace()
+            print(f"chrome trace: {path} ({path.flow_events} flow events, "
+                  f"{path.actors} actor lanes, "
+                  f"{path.skipped_actors} skipped)")
+            print(f"metrics dump: {metrics.dump()}")
     finally:
         raydp_tpu.stop()
 

@@ -35,7 +35,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 import pyarrow as pa
 
-from raydp_tpu import knobs
+from raydp_tpu import knobs, metrics, profiler
 from raydp_tpu.log import get_logger
 
 logger = get_logger("data.feed")
@@ -535,6 +535,10 @@ class PipelineTimings:
     def add(self, key: str, dt: float) -> None:
         with self._lock:
             self._acc[key] += dt
+        # the registry twin: the same observation flows into the typed
+        # metrics plane so metrics_report() sees feed phases without the
+        # estimators re-publishing their epoch dicts
+        metrics.observe("feed_phase_seconds", dt, label=key)
 
     def take(self) -> Dict[str, float]:
         """Snapshot AND reset — each epoch reports its own split."""
@@ -577,11 +581,19 @@ class DevicePrefetcher:
         self._work_key = work_key
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
+        # the prefetch thread must trace under the constructing context
+        # (a serve replica's staging pipeline, an estimator's feed) — a
+        # plain Thread would drop the contextvar at the handoff
+        self._ctx = profiler.capture()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=name)
         self._started = False
 
     def _run(self):
+        with profiler.activate(self._ctx):
+            self._run_inner()
+
+    def _run_inner(self):
         try:
             src = iter(self._src)
             while not self._stop.is_set():

@@ -11,6 +11,7 @@ recipes (SURVEY.md §5 failure-detection subsystem).
 from __future__ import annotations
 
 import collections
+import contextlib
 import heapq
 import math
 import os
@@ -27,7 +28,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
-from raydp_tpu import knobs, profiler
+from raydp_tpu import knobs, metrics, profiler
 from raydp_tpu.etl import optimizer as O
 from raydp_tpu.etl import plan as P
 from raydp_tpu.etl import tasks as T
@@ -257,6 +258,10 @@ class _StreamStageRec:
             self.gens[map_id] += 1
             gen = self.gens[map_id]
             self.seals[map_id] = (ref, list(index))
+        if gen > 1:
+            metrics.inc("stream_reseals_total")
+            metrics.record_event("stream_reseal", stage=self.label,
+                                 map_id=map_id, gen=gen, oid=ref.id)
         get_client().stream_publish(self.stage_key, map_id, gen, ref.id,
                                     int(ref.size or 0), list(index))
 
@@ -544,6 +549,15 @@ class ExecutorPool:
             t = down.get(ident)
             return t is not None and time.monotonic() - t < _DOWN_TTL_S
 
+        def _mark_down(ident: str, name: str) -> None:
+            if not _is_down(ident):
+                # record the TRANSITION, not every probe of an already-down
+                # executor — a 60s unreachable grace of backoff probes must
+                # not flood the bounded flight-recorder ring
+                metrics.inc("sched_executor_down_total", label=name)
+                metrics.record_event("executor_down", executor=name)
+            down[ident] = time.monotonic()
+
         def _any_capacity() -> bool:
             any_live = live_free = False
             for ident in self._idents:
@@ -609,6 +623,7 @@ class ExecutorPool:
             inflight[ident] += 1
             copies[i] += 1
             busy_peak[name] = max(busy_peak.get(name, 0), inflight[ident])
+            metrics.inc("sched_tasks_dispatched_total", label=name)
 
         def _submit(i: int):
             handle, ident = _choose(i)
@@ -628,7 +643,7 @@ class ExecutorPool:
                 # must not burn the task-retry budget: mark the executor
                 # down, rotate, and keep probing within a wall-clock grace.
                 now = time.monotonic()
-                down[ident] = now
+                _mark_down(ident, handle.name or ident)
                 if unreach_since[i] is None:
                     unreach_since[i] = now
                 uprobe[i] += 1
@@ -672,7 +687,7 @@ class ExecutorPool:
                 try:
                     bfut = handle.submit("run_task", blobs[i])
                 except (ConnectionLost, OSError):
-                    down[ident] = time.monotonic()
+                    _mark_down(ident, handle.name or ident)
                     continue
                 speculated.add(i)
                 _register(bfut, i, ident, handle.name or ident, True)
@@ -732,7 +747,7 @@ class ExecutorPool:
                         if err is None:
                             self._free_loser_result(fut, results[i])
                         elif isinstance(err, ConnectionLost):
-                            down[at.ident] = time.monotonic()
+                            _mark_down(at.ident, at.name)
                         continue
                     if err is None:
                         r = fut.result()
@@ -763,7 +778,7 @@ class ExecutorPool:
                     if isinstance(err, ConnectionLost) and at.ident:
                         # the executor died mid-task: steer the resubmit (and
                         # every sibling) away from it while it restarts
-                        down[at.ident] = time.monotonic()
+                        _mark_down(at.ident, at.name)
                     if isinstance(err, RemoteError) \
                             and err.exc_type == "ObjectLostError":
                         lost = _lost_ids_of(err)
@@ -821,6 +836,10 @@ class ExecutorPool:
             fut.add_done_callback(
                 lambda f, w=winner: self._free_loser_result(f, w))
         pending.clear()
+        if speculated:
+            metrics.inc("sched_speculated_total", len(speculated))
+        if spec_won:
+            metrics.inc("sched_speculation_won_total", spec_won)
         if sched_stats is not None:
             sched_stats["speculated"] = \
                 sched_stats.get("speculated", 0) + len(speculated)
@@ -929,6 +948,11 @@ class ExecutorPool:
         are still executing on the pool (there is no remote cancel — draining
         is what keeps them from writing into the store after the driver has
         given up), and free every output the caller will never receive."""
+        metrics.inc("stage_aborts_total")
+        metrics.record_event("stage_abort",
+                             inflight=len(pending),
+                             completed=sum(1 for r in results
+                                           if r is not None))
         self._drain_merge(pending, results, retry_q)
         _free_result_refs(results)
 
@@ -1351,6 +1375,15 @@ class Engine:
         index as its winning result lands (the pipelined shuffle's
         seal-notification hook; ``task_bytes`` is the dispatch payload so an
         incremental lineage ledger costs no extra serialization)."""
+        with profiler.trace("stage:run", "etl", tasks=len(tasks),
+                            label=lineage_label or "-", depth=_depth):
+            return self._run_stage_traced(tasks, preferred, temps,
+                                          lineage_label, sched_stats,
+                                          on_task_result, _depth)
+
+    def _run_stage_traced(self, tasks, preferred=None, temps=None,
+                          lineage_label=None, sched_stats=None,
+                          on_task_result=None, _depth=0):
         tasks = list(tasks)
         results: List[Optional[Dict[str, Any]]] = [None] * len(tasks)
         rounds = _recovery_rounds() \
@@ -1566,6 +1599,12 @@ class Engine:
         mapping: Dict[str, ObjectRef] = {}
         for label, plist in by_label.items():
             rerun = [cloudpickle.loads(p.task_bytes) for p, _ in plist]
+            metrics.inc("recovery_rounds_total")
+            metrics.inc("recovery_blobs_regenerated_total",
+                        sum(len(ids) for _, ids in plist))
+            metrics.record_event(
+                "recovery_round", stage=label, producers=len(plist),
+                lost=sum(len(ids) for _, ids in plist), depth=depth)
             with profiler.trace("recover:lineage", "etl", stage=label,
                                 lost=sum(len(ids) for _, ids in plist),
                                 producers=len(plist)):
@@ -1631,16 +1670,44 @@ class Engine:
         return mapping
 
     # ---- public entry points ------------------------------------------------
+    @contextlib.contextmanager
+    def _action(self, label: str):
+        """Every driver-initiated action runs under one ``etl:action`` root
+        span — minting the ``trace_id`` all its stage/task/recovery spans
+        (local and remote) inherit — and a :class:`StageError` surfacing
+        from it triggers the flight-recorder harvest: every process's event
+        ring lands in a ``blackbox-<label>.json`` postmortem bundle
+        (doc/observability.md), so a chaos-failed action leaves an artifact
+        instead of log archaeology. Harvest failures never mask the error."""
+        with profiler.trace("etl:action", "driver", action=label):
+            try:
+                yield
+            except StageError as e:
+                metrics.record_event("action_failed", action=label,
+                                     exc_type=type(e).__name__,
+                                     error=str(e)[:500])
+                try:
+                    path = metrics.write_blackbox(label, e)
+                    if path:
+                        logger.warning("action %r failed; flight-recorder "
+                                       "bundle written to %s", label, path)
+                except Exception:  # noqa: BLE001 - never mask the failure
+                    logger.warning("blackbox harvest for failed action %r "
+                                   "itself failed", label, exc_info=True)
+                raise
+
     def materialize(self, node: P.PlanNode, owner: Optional[str] = None
                     ) -> Tuple[List[ObjectRef], Optional[bytes], List[int]]:
         """Execute the plan; return per-partition (refs, schema bytes, row counts)."""
         temps = _ActionTemps()
         try:
-            # the returned refs are the action's FINAL outputs: nothing later
-            # in this action can lose them, so ledgering their recipes would
-            # be pure serialization overhead on the data-feed hot path
-            return self._materialize_inner(self._optimized(node), owner,
-                                           temps, lineage_label=None)
+            with self._action("materialize"):
+                # the returned refs are the action's FINAL outputs: nothing
+                # later in this action can lose them, so ledgering their
+                # recipes would be pure serialization overhead on the
+                # data-feed hot path
+                return self._materialize_inner(self._optimized(node), owner,
+                                               temps, lineage_label=None)
         finally:
             self._free(temps)
 
@@ -1663,26 +1730,28 @@ class Engine:
     def collect(self, node: P.PlanNode) -> pa.Table:
         temps = _ActionTemps()
         try:
-            tasks, preferred = self._compile(self._optimized(node), temps)
-            tasks = [t.with_output(output=T.COLLECT) for t in tasks]
-            results = self._run_stage(tasks, preferred, temps)
-            tables = [pa.ipc.open_stream(pa.py_buffer(r["ipc"])).read_all()
-                      for r in results]
-            out = pa.concat_tables(tables, promote_options="permissive")
-            limit = _root_limit(node)
-            return out.slice(0, limit) if limit is not None else out
+            with self._action("collect"):
+                tasks, preferred = self._compile(self._optimized(node), temps)
+                tasks = [t.with_output(output=T.COLLECT) for t in tasks]
+                results = self._run_stage(tasks, preferred, temps)
+                tables = [pa.ipc.open_stream(pa.py_buffer(r["ipc"])).read_all()
+                          for r in results]
+                out = pa.concat_tables(tables, promote_options="permissive")
+                limit = _root_limit(node)
+                return out.slice(0, limit) if limit is not None else out
         finally:
             self._free(temps)
 
     def count(self, node: P.PlanNode) -> int:
         temps = _ActionTemps()
         try:
-            tasks, preferred = self._compile(self._optimized(node), temps)
-            tasks = [t.with_output(output=T.ROWCOUNT) for t in tasks]
-            results = self._run_stage(tasks, preferred, temps)
-            total = sum(r["num_rows"] for r in results)
-            limit = _root_limit(node)
-            return min(total, limit) if limit is not None else total
+            with self._action("count"):
+                tasks, preferred = self._compile(self._optimized(node), temps)
+                tasks = [t.with_output(output=T.ROWCOUNT) for t in tasks]
+                results = self._run_stage(tasks, preferred, temps)
+                total = sum(r["num_rows"] for r in results)
+                limit = _root_limit(node)
+                return min(total, limit) if limit is not None else total
         finally:
             self._free(temps)
 
@@ -1697,6 +1766,10 @@ class Engine:
         them — they are released with the frame (the GC-pin of
         ObjectStoreWriter.scala:175-177).
         """
+        with self._action("cache"):
+            return self._cache_inner(node, frame_id)
+
+    def _cache_inner(self, node: P.PlanNode, frame_id: str) -> P.CachedScan:
         temps = _ActionTemps()
         try:
             tasks, preferred = self._compile(self._optimized(node), temps)
@@ -1764,6 +1837,10 @@ class Engine:
         random_shuffle at torch/estimator.py:335-338). Returns (refs, rows)
         per output block; intermediates are freed before returning.
         """
+        with self._action("random-shuffle"):
+            return self._random_shuffle_inner(refs, schema_bytes, seed, owner)
+
+    def _random_shuffle_inner(self, refs, schema_bytes, seed, owner=None):
         temps = _ActionTemps()
         try:
             nb = max(1, len(refs))
@@ -2069,17 +2146,22 @@ class Engine:
                     pass
 
         sstats: Dict[str, Any] = {}
+        # the map stage runs on a background thread but belongs to the
+        # calling action's trace — hand the context across the Thread gap
+        ctx = profiler.capture()
 
         def _runner():
             try:
-                results = self._run_stage(tasks, preferred, temps,
-                                          lineage_label=label,
-                                          sched_stats=sstats,
-                                          on_task_result=_on_map_result)
-                rec.results = results
-                rec.entry = self._record_stage(label, results, num_buckets,
-                                               temps, sched_stats=sstats,
-                                               pipelined=True)
+                with profiler.activate(ctx):
+                    results = self._run_stage(tasks, preferred, temps,
+                                              lineage_label=label,
+                                              sched_stats=sstats,
+                                              on_task_result=_on_map_result)
+                    rec.results = results
+                    rec.entry = self._record_stage(label, results,
+                                                   num_buckets, temps,
+                                                   sched_stats=sstats,
+                                                   pipelined=True)
             except BaseException as e:  # noqa: BLE001 - reducers must learn
                 rec.error = e
                 try:

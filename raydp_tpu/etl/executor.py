@@ -206,15 +206,22 @@ class EtlExecutor:
         caps."""
         from concurrent.futures import Future
 
+        from raydp_tpu import profiler
         from raydp_tpu.runtime.rpc import DeferredReply
 
         task: T.Task = cloudpickle.loads(task_bytes)
         if T.stream_sources_of(task):
             fut: Future = Future()
+            # the dispatcher thread holds the caller's trace context (the
+            # RPC layer installed it); a plain Thread would lose it — hand
+            # it across explicitly so the task's spans keep their driver
+            # stage as parent
+            ctx = profiler.capture()
 
             def _run():
                 try:
-                    fut.set_result(self._run_task_obj(task))
+                    with profiler.activate(ctx):
+                        fut.set_result(self._run_task_obj(task))
                 except BaseException as e:  # noqa: BLE001 - serialize any
                     fut.set_exception(e)
 

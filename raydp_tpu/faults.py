@@ -89,6 +89,27 @@ SITE_SPECIFIC_ACTIONS = {
 #: used, so supervisors/tests keyed on it keep working
 CRASH_EXIT_CODE = 23
 
+#: flight-recorder hook: ``cb(site, key, action)`` called for every fired
+#: rule. This module is stdlib-only by contract, so it cannot import the
+#: telemetry plane — ``raydp_tpu/profiler.py`` arms the hook at ITS import
+#: (any process running runtime code), and bootstrap-only processes simply
+#: record nothing. Failures in the hook never mask the injected fault.
+_fire_hook = None
+
+
+def set_fire_hook(cb) -> None:
+    global _fire_hook
+    _fire_hook = cb
+
+
+def _notify_fire(site: str, key: str, action: str) -> None:
+    if _fire_hook is None:
+        return
+    try:
+        _fire_hook(site, key, action)
+    except Exception:  # noqa: BLE001 - telemetry must never break injection
+        pass
+
 
 @dataclass
 class FaultRule:
@@ -331,7 +352,10 @@ class FaultPlane:
                 # so the missed fire is observable, not silently swallowed
                 if rule.register_call(key) and fired is None and rule.claim():
                     fired = rule
-            return fired
+        if fired is not None:
+            # outside the lock: the hook may take the telemetry lock
+            _notify_fire(site, key, fired.action)
+        return fired
 
 
 _plane = FaultPlane()

@@ -189,6 +189,9 @@ _ALL = [
        "(borrowed zero-copy views may still be live)."),
     _k("RDT_PROFILER_MAX_SPANS", "int", 100000, PROCESS_START, "runtime",
        "Bound on retained trace spans per process."),
+    _k("RDT_FLIGHT_MAX_EVENTS", "int", 1024, PROCESS_START, "runtime",
+       "Bound on the per-process flight-recorder event ring "
+       "(doc/observability.md); evictions are counted, never silent."),
     _k("RDT_STORE_ISOLATED", "bool", False, PROCESS_START, "runtime",
        "Force a node agent to host its own payload plane even on the head's "
        "machine (the multi-host store topology, in tests)."),

@@ -60,7 +60,14 @@ class _ActorServer:
             return True
         if method == "__rdt_spans__":
             from raydp_tpu import profiler
-            return profiler.spans()
+            return profiler.export_spans()
+        if method == "__rdt_metrics__":
+            from raydp_tpu import metrics
+            return metrics.export_state()
+        if method == "__rdt_clock__":
+            # the driver's clock-offset handshake: this process's wall
+            # clock, nothing else — the round trip must stay minimal
+            return time.time_ns()
         return self._dispatch(method, args, kwargs)
 
 

@@ -82,6 +82,28 @@ class NodeAgentService:
     def ping(self) -> str:
         return "pong"
 
+    # ---- telemetry (doc/observability.md) -----------------------------------
+    def telemetry(self):
+        """This agent process's full observability state — spans, thread
+        names, metrics, and flight-recorder events — the node-agent twin of
+        the actor ``__rdt_spans__`` intrinsic, for trace collection."""
+        from raydp_tpu import metrics, profiler
+        out = profiler.export_spans()
+        out.update(metrics.export_state())
+        return out
+
+    def metrics_state(self):
+        """Metrics + events only (``__rdt_metrics__`` twin) — what the
+        metrics/blackbox harvests want; the span ring (up to
+        RDT_PROFILER_MAX_SPANS entries) would be pure transfer weight
+        there and megabytes of dead JSON in a blackbox bundle."""
+        from raydp_tpu import metrics
+        return metrics.export_state()
+
+    def clock_ns(self) -> int:
+        """The driver's clock-offset handshake (``__rdt_clock__`` twin)."""
+        return time.time_ns()
+
     # ---- node-local payload plane (isolated store mode) ---------------------
     def store_fetch(self, segment: str, offset: int, size: int) -> bytes:
         """Serve payload bytes hosted on this machine to a reader elsewhere —

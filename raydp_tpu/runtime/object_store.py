@@ -45,7 +45,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import cloudpickle
 import pyarrow as pa
 
-from raydp_tpu import faults
+from raydp_tpu import faults, metrics
 from raydp_tpu.log import get_logger
 from raydp_tpu import knobs
 from raydp_tpu.runtime.rpc import DeferredReply
@@ -70,6 +70,14 @@ class ObjectLostError(KeyError):
             msg += f" ({detail})"
         super().__init__(msg)
         self.object_id = object_id
+        # flight recorder: constructed exactly at loss-detection sites, so
+        # recording here covers every raise path (local KeyError translate,
+        # RPC-proxied RemoteError, vanished segment, dead payload host)
+        try:
+            metrics.inc("store_objects_lost_total")
+            metrics.record_event("object_lost", oid=object_id, detail=detail)
+        except Exception:  # noqa: BLE001 - telemetry never masks the loss
+            pass
 
     # not KeyError.__str__: loss messages must not render repr-quoted in
     # logs, RemoteError.message, and ObjectsLostError text
@@ -533,6 +541,9 @@ class ObjectStoreServer:
     def _count_op(self, name: str) -> None:
         with self._op_lock:
             self._op_counts[name] = self._op_counts.get(name, 0) + 1
+        # registry twin of op_counts(): metrics_report()'s store_ops_total
+        # subsumes this dict (which stays as the compatible view)
+        metrics.inc("store_ops_total", label=name)
 
     def op_counts(self) -> Dict[str, int]:
         """Per-method control-plane operation counts since start/reset. A

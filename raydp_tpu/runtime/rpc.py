@@ -6,10 +6,16 @@ Spark netty RpcEnv (RayAppMaster.scala:63-74), Ray actor RPC, and the MPI gRPC p
 (mpi/network/network.proto:22-37). One wire format, usable cross-host: frames are
 ``8-byte big-endian length || cloudpickle payload``.
 
-Requests are ``(req_id, method, args, kwargs)``; responses ``(req_id, ok, value)``
-where a failed call carries a :class:`RemoteError` payload with the remote traceback.
-Responses may arrive out of order — the client demultiplexes on ``req_id`` — so a
-server may process calls concurrently (actors declare a ``max_concurrency``, parity
+Requests are ``(req_id, method, args, kwargs[, meta])``; responses
+``(req_id, ok, value)`` where a failed call carries a :class:`RemoteError`
+payload with the remote traceback. The optional fifth element is call
+metadata — today the caller's causal-trace context
+(``{"trace": (trace_id, parent_span_id)}``), which the dispatcher installs
+in a ``contextvars`` context around the handler so remote spans record
+their driver-side parentage (doc/observability.md). A four-element request
+from a legacy/external caller dispatches unchanged. Responses may arrive
+out of order — the client demultiplexes on ``req_id`` — so a server may
+process calls concurrently (actors declare a ``max_concurrency``, parity
 with RayExecutorUtils.java:60 ``setMaxConcurrency(2)``).
 """
 
@@ -152,8 +158,13 @@ class RpcServer:
         try:
             while not self._stopped.is_set():
                 frame = _recv_frame(conn)
-                req_id, method, args, kwargs = cloudpickle.loads(frame)
-                self._pool.submit(self._dispatch, conn, send_lock, req_id, method, args, kwargs)
+                req = cloudpickle.loads(frame)
+                # tolerate the legacy 4-tuple: a caller without trace
+                # metadata must dispatch exactly as before
+                req_id, method, args, kwargs = req[:4]
+                meta = req[4] if len(req) > 4 else None
+                self._pool.submit(self._dispatch, conn, send_lock, req_id,
+                                  method, args, kwargs, meta)
         except (ConnectionLost, OSError):
             pass
         except BaseException as e:  # noqa: BLE001 - diagnose, drop only this conn
@@ -165,9 +176,16 @@ class RpcServer:
             except OSError:
                 pass
 
-    def _dispatch(self, conn, send_lock, req_id, method, args, kwargs) -> None:
+    def _dispatch(self, conn, send_lock, req_id, method, args, kwargs,
+                  meta=None) -> None:
         try:
-            value = self._handler(method, args, kwargs)
+            # install the caller's trace context for the handler body (and
+            # anything the handler captures for worker threads / deferred
+            # completions); reset before the pool thread moves on
+            from raydp_tpu import profiler
+            ctx = meta.get("trace") if isinstance(meta, dict) else None
+            with profiler.activate(ctx):
+                value = self._handler(method, args, kwargs)
             if isinstance(value, DeferredReply):
                 # this dispatcher thread goes back to the pool now; the reply
                 # is sent from a POOL thread at completion — never from the
@@ -279,7 +297,11 @@ class RpcClient:
             req_id = self._next_id
             self._next_id += 1
             self._pending[req_id] = fut
-        payload = cloudpickle.dumps((req_id, method, args, kwargs))
+        from raydp_tpu import profiler
+        ctx = profiler.current_trace()
+        payload = cloudpickle.dumps(
+            (req_id, method, args, kwargs, {"trace": ctx})
+            if ctx is not None else (req_id, method, args, kwargs))
         try:
             _send_frame(self._sock, payload, self._send_lock)
         except OSError as e:

@@ -79,6 +79,12 @@ class _Replica:
     # -- dispatcher side (RPC thread): enqueue only ---------------------------
     def submit(self, payload: bytes) -> Future:
         fut: Future = Future()
+        # the RPC dispatcher thread holds the driver's trace context (the
+        # serve:batch span); the prefetcher and worker threads that carry
+        # this request forward cannot inherit it — capture it into the
+        # queue item so the staging decode and the jitted apply trace as
+        # children of the driver dispatch
+        ctx = profiler.capture()
         with self._lock:
             if self._stopped:
                 raise ReplicaNotLoaded(
@@ -90,7 +96,7 @@ class _Replica:
             # stop sentinel, so a request can never land BEHIND the
             # sentinel (its future would silently never complete — the
             # queue is unbounded, so the put cannot block here)
-            self._q.put((payload, fut))
+            self._q.put((payload, fut, ctx))
         return fut
 
     # -- staging (DevicePrefetcher thread) ------------------------------------
@@ -104,13 +110,14 @@ class _Replica:
     def _stage(self, item):
         """decode + place one request's batch; a per-item failure rides to
         the worker attached to ITS future instead of killing the pipeline."""
-        payload, fut = item
+        payload, fut, ctx = item
         try:
-            table = pa.ipc.open_stream(pa.py_buffer(payload)).read_all()
-            placed = self.servable.place(self.servable.decode(table))
-            return placed, table.num_rows, fut, None
+            with profiler.activate(ctx):
+                table = pa.ipc.open_stream(pa.py_buffer(payload)).read_all()
+                placed = self.servable.place(self.servable.decode(table))
+            return placed, table.num_rows, fut, ctx, None
         except BaseException as e:  # noqa: BLE001 - belongs to this request
-            return None, 0, fut, e
+            return None, 0, fut, ctx, e
 
     # -- apply (worker thread) ------------------------------------------------
     def _serve_loop(self) -> None:
@@ -119,7 +126,7 @@ class _Replica:
         staged = DevicePrefetcher(
             self._items(), fn=self._stage, depth=self._prefetch,
             name=f"rdt-serve-stage-{self.replica_id}")
-        for placed, rows, fut, err in staged:
+        for placed, rows, fut, ctx, err in staged:
             if err is not None:
                 fut.set_exception(err)
                 continue
@@ -135,8 +142,9 @@ class _Replica:
                 if rule is not None:
                     faults.apply(rule, "serve.predict")
                 t0 = time.perf_counter()
-                with profiler.trace("serve:apply", "serve",
-                                    replica=self.replica_id, rows=rows):
+                with profiler.activate(ctx), \
+                        profiler.trace("serve:apply", "serve",
+                                       replica=self.replica_id, rows=rows):
                     preds = self.servable.apply(placed)
                 dt = time.perf_counter() - t0
                 with self._lock:

@@ -46,7 +46,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 import pyarrow as pa
 
-from raydp_tpu import knobs, profiler
+from raydp_tpu import knobs, metrics, profiler
 from raydp_tpu.log import get_logger
 from raydp_tpu.runtime.rpc import ConnectionLost, RemoteError
 
@@ -108,13 +108,27 @@ def _quantile(sample: Sequence[float], q: float) -> float:
 
 
 class _Request:
-    __slots__ = ("table", "fut", "t_enq", "rows")
+    __slots__ = ("table", "fut", "t_enq", "rows", "span")
 
     def __init__(self, table: pa.Table, fut: Future):
         self.table = table
         self.fut = fut
         self.t_enq = time.monotonic()
         self.rows = table.num_rows
+        # the request's serve:predict span opens on the caller's thread
+        # (joining the caller's trace, or minting one) and closes when the
+        # demuxed result lands; its context is what the dispatcher
+        # activates around the batch submit, so serve:batch / serve:hedge /
+        # replica serve:apply all parent here
+        self.span = profiler.open_span("serve:predict", "serve",
+                                       rows=self.rows)
+
+    @property
+    def ctx(self):
+        return profiler.span_context(self.span)
+
+    def finish(self, **args) -> None:
+        profiler.close_span(self.span, **args)
 
 
 class _Attempt:
@@ -334,6 +348,13 @@ class ServingSession:
                 self._flush_batches()
                 self._maybe_hedge()
                 self._retry_parked()
+                # refresh on every loop pass (arrivals, flushes, drains
+                # alike) so an idle session reads 0, not the last
+                # pre-dispatch depth; labeled per session so two sessions
+                # in one driver never overwrite each other's slot
+                metrics.set_gauge("serve_queue_depth",
+                                  len(self._pending) + len(self._inflight),
+                                  label=self.name)
             except Exception:  # noqa: BLE001 - the loop must survive anything
                 # a dead dispatcher bricks every current and future request;
                 # per-batch/per-dispatch errors are already routed to their
@@ -361,6 +382,7 @@ class ServingSession:
     # -- batching -------------------------------------------------------------
     def _on_request(self, req: _Request) -> None:
         self._stats["requests"] += 1
+        metrics.inc("serve_requests_total")
         self._pending.append(req)
         self._pending_rows += req.rows
         self._queue_depth_peak = max(
@@ -410,6 +432,9 @@ class ServingSession:
         d = _Dispatch(next(self._did), payload, rows, parts)
         self._stats["batches"] += 1
         self._stats["rows"] += rows
+        metrics.inc("serve_batches_total")
+        metrics.inc("serve_rows_total", rows)
+        metrics.observe("serve_batch_occupancy_rows", rows)
         self._occupancy.append(rows)
         if len(self._occupancy) > _LAT_WINDOW:
             del self._occupancy[:-_LAT_WINDOW]
@@ -452,11 +477,15 @@ class ServingSession:
         try:
             # the span covers the driver-side submit (encode happened at
             # coalesce time); the replica-side serve:apply span carries the
-            # device half of the timeline
-            with profiler.trace(span, "serve", replica=rep.rid,
-                                rows=d.rows, requests=len(d.parts)):
-                replica = rep.replica
-                fut = replica.submit("serve_predict", rep.rid, d.payload)
+            # device half of the timeline. The batch joins the FIRST
+            # coalesced request's trace (a batch has one parent lane; the
+            # sibling requests' spans still record their own latency), so
+            # the RPC layer ships serve:batch as the remote apply's parent
+            with profiler.activate(d.parts[0][0].ctx if d.parts else None):
+                with profiler.trace(span, "serve", replica=rep.rid,
+                                    rows=d.rows, requests=len(d.parts)):
+                    replica = rep.replica
+                    fut = replica.submit("serve_predict", rep.rid, d.payload)
         except (ConnectionLost, OSError) as e:
             # the executor is unreachable (restarting): take the replica out
             # of rotation, start its background reload, and re-route
@@ -524,6 +553,7 @@ class ServingSession:
             # the loser of a won hedge (or of a rescue): discard, count
             if err is None and att is not None:
                 self._stats["hedge_lost"] += 1
+                metrics.inc("serve_hedge_lost_total")
             if not d.attempts:
                 self._inflight.pop(did, None)
             if err is not None:
@@ -533,6 +563,7 @@ class ServingSession:
             d.done = True
             if att is not None and att.hedge:
                 self._stats["hedge_won"] += 1
+                metrics.inc("serve_hedge_won_total")
             now = time.monotonic()
             if att is not None:
                 self._batch_lat.append(now - att.t0)
@@ -543,6 +574,8 @@ class ServingSession:
                 if not req.fut.done():  # close()/race-failed futures skip
                     req.fut.set_result(preds[off:off + req.rows])
                 self._req_lat.append(now - req.t_enq)
+                metrics.observe("serve_request_seconds", now - req.t_enq)
+                req.finish(replica=rid)
             if len(self._req_lat) > _LAT_WINDOW:
                 del self._req_lat[:-_LAT_WINDOW]
             if not d.attempts:
@@ -567,6 +600,7 @@ class ServingSession:
             self._fail_dispatch(d)
             return
         self._stats["rerouted"] += 1
+        metrics.inc("serve_rerouted_total")
         logger.warning("serve dispatch %d re-routing off %s after: %s",
                        d.id, rep.rid if rep else "?", err)
         self._submit(d, hedge=False)
@@ -575,6 +609,7 @@ class ServingSession:
         d.done = True
         self._inflight.pop(d.id, None)
         self._stats["failed"] += len(d.parts)
+        metrics.inc("serve_failed_total", len(d.parts))
         err = ServingError(
             f"request failed on every replica within "
             f"{self._reroute_grace_s:.0f}s (last error: {d.last_error})")
@@ -582,6 +617,29 @@ class ServingSession:
         for req, _ in d.parts:
             if not req.fut.done():
                 req.fut.set_exception(err)
+            req.finish(failed=True)
+        metrics.record_event("request_failed", dispatch=d.id,
+                             requests=len(d.parts),
+                             last_error=str(d.last_error)[:300])
+        # the ServingError postmortem bundle (doc/observability.md) — on a
+        # BACKGROUND thread: the harvest RPCs every live process with a 10s
+        # timeout each, and this runs on the dispatcher event loop, which
+        # must keep batching/hedging/demuxing the session's OTHER requests
+        # (a hung executor is exactly the scenario that got us here).
+        # Capped per label inside write_blackbox, best-effort by contract.
+        threading.Thread(target=self._write_blackbox_bg, args=(err,),
+                         daemon=True,
+                         name=f"rdt-serve-blackbox-{self.name}").start()
+
+    def _write_blackbox_bg(self, err: BaseException) -> None:
+        try:
+            path = metrics.write_blackbox(f"serve-{self.name}", err)
+            if path:
+                logger.warning("serve request failed on every replica; "
+                               "flight-recorder bundle written to %s", path)
+        except Exception:  # noqa: BLE001 - never mask the request failure
+            logger.warning("blackbox harvest for failed serve dispatch "
+                           "failed", exc_info=True)
 
     def _note_replica_error(self, att: Optional[_Attempt],
                             err: BaseException) -> None:
@@ -598,6 +656,9 @@ class ServingSession:
             return
         rep.ready = False
         rep.reloading = True
+        metrics.record_event("replica_down", replica=rep.rid,
+                             executor=rep.executor,
+                             error=type(err).__name__)
         threading.Thread(target=self._reload, args=(rep,), daemon=True,
                          name=f"rdt-serve-reload-{rep.rid}").start()
 
@@ -629,6 +690,8 @@ class ServingSession:
             rep.ready = True
             rep.reloads += 1
             rep.inflight = 0
+            metrics.record_event("replica_up", replica=rep.rid,
+                                 executor=rep.executor)
             logger.info("replica %s reloaded and back in rotation", rep.rid)
 
     # -- hedging --------------------------------------------------------------
@@ -658,6 +721,9 @@ class ServingSession:
                 if self._submit(d, hedge=True):
                     d.hedged = True
                     self._stats["hedged"] += 1
+                    metrics.inc("serve_hedged_total")
+                    metrics.record_event("hedge", dispatch=d.id,
+                                         rows=d.rows)
 
     # -- reporting / teardown -------------------------------------------------
     def _report(self) -> Dict[str, Any]:
@@ -692,12 +758,14 @@ class ServingSession:
         for req in self._pending:
             if not req.fut.done():
                 req.fut.set_exception(err)
+            req.finish(failed=True)
         self._pending = []
         for d in list(self._inflight.values()) + self._parked:
             if not d.done:
                 for req, _ in d.parts:
                     if not req.fut.done():
                         req.fut.set_exception(err)
+                    req.finish(failed=True)
         self._inflight.clear()
         self._parked = []
         # requests enqueued behind the stop event would otherwise hold
@@ -707,7 +775,9 @@ class ServingSession:
                 ev = self._events.get_nowait()
             except queue.Empty:
                 break
-            if ev[0] == "req" and not ev[1].fut.done():
-                ev[1].fut.set_exception(err)
+            if ev[0] == "req":
+                if not ev[1].fut.done():
+                    ev[1].fut.set_exception(err)
+                ev[1].finish(failed=True)
             elif ev[0] == "report":
                 ev[1].set_result(self._report())

@@ -1,6 +1,6 @@
 """rdtlint — project-native static analysis for raydp_tpu.
 
-Seven rule families, each encoding an invariant this repo's reviews kept
+Eight rule families, each encoding an invariant this repo's reviews kept
 re-finding by hand (see doc/dev_lint.md for the full reference and the
 annotation conventions):
 
@@ -21,6 +21,10 @@ annotation conventions):
   planes; result-ref keys stay in sync with ``engine._result_refs``.
 - ``exc-contract`` — every ``RemoteError.exc_type`` string comparison names
   a real exception class (repo, builtin, or allowlisted external).
+- ``telemetry-registry`` — every literal ``profiler.trace(...)`` span name,
+  ``metrics.*`` metric name (with the right kind), and flight-recorder
+  event kind is declared in ``raydp_tpu/metrics.py``, and the generated
+  tables in doc/observability.md are fresh.
 
 Run it::
 
@@ -36,7 +40,7 @@ from typing import Iterable, List, Optional
 
 from raydp_tpu.tools.rdtlint import (
     rule_dispatcher, rule_exc, rule_faults, rule_knobs, rule_locks,
-    rule_rpc, rule_steps)
+    rule_rpc, rule_steps, rule_telemetry)
 from raydp_tpu.tools.rdtlint.core import (
     RULES, Project, Report, Violation, apply_suppressions)
 
@@ -48,6 +52,7 @@ _RULE_CHECKS = {
     "rpc-surface": rule_rpc.check,
     "step-registry": rule_steps.check,
     "exc-contract": rule_exc.check,
+    "telemetry-registry": rule_telemetry.check,
 }
 
 

@@ -93,6 +93,7 @@ RPC_RECEIVER_SURFACES = {
 #: remote names.
 RPC_INTRINSIC_METHODS = frozenset({
     "__rdt_ping__", "__rdt_shutdown__", "__rdt_spans__",
+    "__rdt_metrics__", "__rdt_clock__",
 })
 
 #: head proxy naming: ``HeadService.store_<m>`` forwards to

@@ -24,6 +24,7 @@ RULES = (
     "rpc-surface",
     "step-registry",
     "exc-contract",
+    "telemetry-registry",
 )
 
 _SUPPRESS_RE = re.compile(

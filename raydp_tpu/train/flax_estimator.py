@@ -513,6 +513,10 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 train_loss = float(loss_sum) / steps if steps else float("nan")
                 t_sync = time.perf_counter() - ts
                 dt = time.perf_counter() - t0
+                # registry twin of the epoch report (metrics_report() sees
+                # epoch walls without re-publishing the history dicts)
+                from raydp_tpu import metrics as rdt_metrics
+                rdt_metrics.observe("train_epoch_seconds", dt)
                 # the feed's thread-side phase split (decode/stage/h2d): these
                 # walls OVERLAP dispatch by design (that is the prefetch win),
                 # so they attribute the epoch, they don't sum to it

@@ -576,6 +576,9 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                 loss_host = float(loss_sum) / steps if steps else float("nan")
                 t_sync = _time.perf_counter() - ts
                 dt = _time.perf_counter() - t0
+                # registry twin of the epoch report (see the flax estimator)
+                from raydp_tpu import metrics as rdt_metrics
+                rdt_metrics.observe("train_epoch_seconds", dt)
                 # the feed's thread-side decode/stage/h2d split — these walls
                 # OVERLAP dispatch (the prefetch win), see the flax twin
                 pipe = feed.timings.take() if feed is not None else {}
@@ -809,6 +812,9 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
                 if i < len(epoch_times) and epoch_times[i] > 0:
                     row["epoch_time_s"] = epoch_times[i]
                     row["samples_per_s"] = n_rows / epoch_times[i]
+                    from raydp_tpu import metrics as rdt_metrics
+                    rdt_metrics.observe("train_epoch_seconds",
+                                        epoch_times[i])
                 history.append(row)
             self._trained_model = model
             self._result = TrainingResult(state=model, history=history,

@@ -38,12 +38,14 @@ def test_tree_is_clean():
 
 
 def test_tests_and_benchmarks_knob_fault_scan_is_clean():
-    """The CI sweep leg: the knob and fault-site families over tests/ and
-    benchmarks/ too — direct RDT_* env reads in test code used to escape
-    the package leg entirely."""
+    """The CI sweep leg: the knob, fault-site, and telemetry families over
+    tests/ and benchmarks/ too — direct RDT_* env reads (and unregistered
+    span/metric literals) in test code used to escape the package leg
+    entirely."""
     report = run([PKG, os.path.join(REPO, "tests"),
                   os.path.join(REPO, "benchmarks")], root=REPO,
-                 rules=["knob-registry", "fault-site-sync"])
+                 rules=["knob-registry", "fault-site-sync",
+                        "telemetry-registry"])
     assert not report.unsuppressed, "\n" + report.render()
 
 
@@ -1114,3 +1116,164 @@ def test_write_rpc_docs_fails_loudly_on_missing_doc_or_markers(tmp_path,
     assert rdtlint_main([str(root / "pkg"), "--root", str(root),
                          "--write-rpc-docs"]) == 2
     assert "markers" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# rule 8: telemetry-registry
+# ---------------------------------------------------------------------------
+
+_TELEMETRY_REGISTRY = """
+    from dataclasses import dataclass
+
+
+    @dataclass(frozen=True)
+    class Metric:
+        name: str
+        kind: str
+
+
+    @dataclass(frozen=True)
+    class Span:
+        name: str
+        dynamic: bool = False
+
+
+    @dataclass(frozen=True)
+    class Event:
+        kind: str
+
+
+    _ALL_METRICS = [
+        Metric("good_total", "counter"),
+        Metric("depth_now", "gauge"),
+        Metric("lat_seconds", "histogram"),
+    ]
+    METRICS = {m.name: m for m in _ALL_METRICS}
+    _ALL_SPANS = [Span("good:span"), Span("task:", dynamic=True)]
+    SPANS = {s.name: s for s in _ALL_SPANS}
+    SPAN_NAMES = frozenset(s.name for s in _ALL_SPANS if not s.dynamic)
+    SPAN_PREFIXES = tuple(s.name for s in _ALL_SPANS if s.dynamic)
+    _ALL_EVENTS = [Event("good_event")]
+    EVENTS = {e.kind: e for e in _ALL_EVENTS}
+"""
+
+
+def test_telemetry_rule_flags_unregistered_names_and_kind_mismatch(tmp_path):
+    report = _lint(tmp_path, {
+        "pkg/metrics.py": _TELEMETRY_REGISTRY,
+        "pkg/user.py": """
+            from raydp_tpu import metrics, profiler
+
+
+            def f(dyn):
+                with profiler.trace("good:span"):
+                    pass
+                with profiler.trace("task:Whatever"):  # dynamic family
+                    pass
+                with profiler.trace(f"task:{dyn}"):    # f-string: skipped
+                    pass
+                with profiler.trace("bad:span"):
+                    pass
+                metrics.inc("good_total")
+                metrics.set_gauge("depth_now", 2)
+                metrics.observe("lat_seconds", 1.0)
+                metrics.inc("lat_seconds")
+                metrics.inc("missing_total")
+                metrics.record_event("good_event")
+                metrics.record_event("bad_event")
+        """,
+    }, rules=["telemetry-registry"])
+    msgs = _msgs(report, "telemetry-registry")
+    assert any("'bad:span'" in m and "not declared" in m for m in msgs)
+    assert any("'missing_total'" in m for m in msgs)
+    assert any("'lat_seconds'" in m and "histogram" in m
+               and "counter" in m for m in msgs)
+    assert any("'bad_event'" in m for m in msgs)
+    assert len(msgs) == 4  # the registered/dynamic/f-string uses are clean
+
+
+def test_telemetry_rule_flags_dead_registry_entries(tmp_path):
+    report = _lint(tmp_path, {
+        "pkg/metrics.py": _TELEMETRY_REGISTRY,
+        "pkg/user.py": """
+            from raydp_tpu import metrics
+
+
+            def f():
+                metrics.inc("good_total")
+        """,
+    }, rules=["telemetry-registry"])
+    msgs = _msgs(report, "telemetry-registry")
+    for dead in ("'good:span'", "'depth_now'", "'lat_seconds'",
+                 "'good_event'"):
+        assert any(dead in m and "no linted code references" in m
+                   for m in msgs), (dead, msgs)
+    assert not any("'good_total'" in m for m in msgs)
+
+
+def test_telemetry_rule_skipped_without_registry(tmp_path):
+    report = _lint(tmp_path, {
+        "pkg/user.py": """
+            from raydp_tpu import profiler
+
+
+            def f():
+                with profiler.trace("anything:goes"):
+                    pass
+        """,
+    }, rules=["telemetry-registry"])
+    assert _msgs(report, "telemetry-registry") == []
+
+
+def test_fence_breaks_when_span_literal_renamed(tmp_path):
+    """The acceptance mutation fence: renaming ONE literal span name in the
+    live tree must break the telemetry fence (the registered name becomes
+    dead telemetry)."""
+    root = tmp_path / "mut"
+    shutil.copytree(PKG, root / "raydp_tpu",
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    (root / "pyproject.toml").write_text("[project]\nname='x'\n")
+    clean = run([str(root / "raydp_tpu")], root=str(root),
+                rules=["telemetry-registry"])
+    assert _msgs(clean, "telemetry-registry") == []
+
+    ex = root / "raydp_tpu" / "etl" / "executor.py"
+    text = ex.read_text()
+    assert text.count('"shuffle:bucket"') == 1
+    ex.write_text(text.replace('"shuffle:bucket"', '"shuffle:buckety"'))
+    report = run([str(root / "raydp_tpu")], root=str(root),
+                 rules=["telemetry-registry"])
+    msgs = _msgs(report, "telemetry-registry")
+    assert any("'shuffle:bucket'" in m and "no linted code references" in m
+               for m in msgs), msgs
+
+
+def test_fence_breaks_when_telemetry_doc_table_stale(tmp_path, capsys):
+    """Doc drift + the --write-docs roundtrip: a hand-edited generated
+    table is a violation until `python -m raydp_tpu.metrics --write-docs`
+    regenerates it."""
+    root = tmp_path / "mut"
+    shutil.copytree(PKG, root / "raydp_tpu",
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    (root / "pyproject.toml").write_text("[project]\nname='x'\n")
+    (root / "doc").mkdir()
+    shutil.copyfile(os.path.join(REPO, "doc", "observability.md"),
+                    root / "doc" / "observability.md")
+    clean = run([str(root / "raydp_tpu")], root=str(root),
+                rules=["telemetry-registry"])
+    assert _msgs(clean, "telemetry-registry") == []
+
+    doc = root / "doc" / "observability.md"
+    doc.write_text(doc.read_text().replace(
+        "| `store_ops_total` |", "| `store_ops_totally` |"))
+    report = run([str(root / "raydp_tpu")], root=str(root),
+                 rules=["telemetry-registry"])
+    assert any("stale" in m and "raydp_tpu.metrics --write-docs" in m
+               for m in _msgs(report, "telemetry-registry"))
+
+    from raydp_tpu.metrics import main as metrics_main
+    assert metrics_main(["--write-docs", "--root", str(root)]) == 0
+    assert "rewrote" in capsys.readouterr().out
+    report = run([str(root / "raydp_tpu")], root=str(root),
+                 rules=["telemetry-registry"])
+    assert _msgs(report, "telemetry-registry") == []
