@@ -1,0 +1,270 @@
+"""Elastic-pool bench: autoscale under a queued burst + chaos-hardened
+scale-down (ISSUE 13 acceptance).
+
+Two configs, each a fresh session:
+
+1. ``autoscale`` — a 1-executor session with the controller armed
+   (min=1, max=3, fast cadence) under a seeded per-task delay
+   (``executor.run_task:delay`` — the queued-burst model): a burst of
+   concurrent groupagg actions must GROW the pool, every action must
+   succeed with identical results, and the idle window afterwards must
+   DRAIN the pool back to min. The record carries the controller's event
+   timeline, the peak size, and the action-failure count (must be 0).
+2. ``chaos_scale`` — the scale-down chaos contract: a 3-executor session
+   runs a PIPELINED groupagg (AQE off) with a seeded per-map delay, a
+   dropped map blob (forcing a lineage-recovery round), and a
+   ``pool.drain:crash`` rule that kills the retiring executor MID-DRAIN
+   when the bench retires it mid-action. The action must return bytes
+   identical to a fault-free fixed-pool BARRIER run, the store must end at
+   its pre-action object count, and a flight-recorder bundle written at
+   the end must carry the drain/recovery evidence chain
+   (``executor_drain`` → ``executor_down`` → ``recovery_round``).
+
+``--smoke`` shrinks the load, writes to /tmp (never the recorded
+artifact), and ASSERTS the CI contract above; the full run records
+``benchmarks/SCALE.json`` (override with ``--out``).
+
+Run: RDT_FAULTS_SEED=7 python benchmarks/scale_bench.py [--smoke] [--out P]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _ipc_bytes(table):
+    import pyarrow as pa
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
+def _frame(session, rows, parts):
+    rng = np.random.RandomState(0)
+    pdf = pd.DataFrame({
+        "k": rng.randint(0, 50, rows),
+        "v": rng.randint(0, 1000, rows).astype(np.int64),
+    })
+    return session.createDataFrame(pdf, num_partitions=parts)
+
+
+def _groupagg_bytes(session, df):
+    from raydp_tpu.etl import functions as F
+    out = df.groupBy("k").agg(F.sum("v").alias("s"), F.count("v").alias("n"))
+    return _ipc_bytes(session.engine.collect(out._plan)
+                      .sort_by([("k", "ascending")]))
+
+
+def run_autoscale_config(smoke):
+    """Config 1: queued burst grows the pool, idle drains it back."""
+    import raydp_tpu
+
+    rows = 8_000 if smoke else 40_000
+    parts = 8 if smoke else 16
+    burst = 3 if smoke else 4
+    os.environ.update({
+        "RDT_POOL_SCALE_INTERVAL_S": "0.2",
+        "RDT_POOL_SCALE_UP_S": "0.4",
+        "RDT_POOL_IDLE_S": "1.5",
+        "RDT_POOL_COOLDOWN_S": "1.0",
+        "RDT_FAULTS": "executor.run_task:delay:ms=400",
+    })
+    t0 = time.time()
+    s = raydp_tpu.init("scale-bench", num_executors=1, executor_cores=1,
+                       executor_memory="512MB")
+    try:
+        auto = s.autoscale(min_size=1, max_size=3)
+        df = _frame(s, rows, parts)
+        results, errors = [], []
+
+        def run():
+            try:
+                results.append(_groupagg_bytes(s, df))
+            except Exception as e:  # noqa: BLE001 - counted below
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=run) for _ in range(burst)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        peak = max([1] + [e["size"] for e in auto.events
+                          if e["direction"] == "up"])
+        burst_wall = time.time() - t0
+        deadline = time.time() + 60
+        while time.time() < deadline and len(s.executors) > 1:
+            time.sleep(0.3)
+        final = len(s.executors)
+        identical = len(set(results)) <= 1
+        record = {
+            "burst_actions": burst,
+            "failed_actions": len(errors),
+            "errors": errors,
+            "results_identical": identical,
+            "peak_pool_size": peak,
+            "final_pool_size": final,
+            "grew": peak > 1,
+            "shrank_to_min": final == 1,
+            "burst_wall_s": round(burst_wall, 2),
+            "scale_events": [{"direction": e["direction"], "size": e["size"],
+                              "reason": e["reason"]} for e in auto.events],
+        }
+    finally:
+        raydp_tpu.stop()
+        for k in ("RDT_POOL_SCALE_INTERVAL_S", "RDT_POOL_SCALE_UP_S",
+                  "RDT_POOL_IDLE_S", "RDT_POOL_COOLDOWN_S", "RDT_FAULTS"):
+            os.environ.pop(k, None)
+    print(f"[autoscale] peak={record['peak_pool_size']} "
+          f"final={record['final_pool_size']} "
+          f"failed={record['failed_actions']} "
+          f"identical={record['results_identical']}")
+    return record
+
+
+def run_chaos_scale_config(smoke):
+    """Config 2: drain-crash racing a pipelined groupagg + recovery."""
+    import raydp_tpu
+    from raydp_tpu import metrics
+    from raydp_tpu.runtime.object_store import get_client
+
+    rows = 8_000 if smoke else 40_000
+
+    # fault-free fixed-pool BARRIER baseline
+    os.environ["RDT_ETL_AQE"] = "0"
+    os.environ["RDT_SHUFFLE_PIPELINE"] = "0"
+    s = raydp_tpu.init("scale-chaos-base", num_executors=3,
+                       executor_cores=1, executor_memory="512MB")
+    try:
+        base = _groupagg_bytes(s, _frame(s, rows, 4))
+    finally:
+        raydp_tpu.stop()
+
+    # chaos run: pipelined, slowed maps, a dropped map blob (recovery),
+    # and a drain-crash fired when the bench retires executor -2
+    sentinels = [os.path.join(tempfile.gettempdir(),
+                              f"rdt_scale_bench_{os.getpid()}_{n}.sentinel")
+                 for n in ("crash", "drop")]
+    for p in sentinels:
+        if os.path.exists(p):
+            os.remove(p)
+    os.environ["RDT_SHUFFLE_PIPELINE"] = "1"
+    os.environ["RDT_FAULTS"] = (
+        "executor.run_task:delay:ms=400:match=|mt-;"
+        f"shuffle.write:drop:nth=2:once={sentinels[1]};"
+        f"pool.drain:crash:once={sentinels[0]}")
+    s = raydp_tpu.init("scale-chaos", num_executors=3, executor_cores=1,
+                       executor_memory="512MB")
+    try:
+        metrics.reset()
+        client = get_client()
+        df = _frame(s, rows, 4)
+        before = client.stats()["num_objects"]
+        box = {}
+
+        def run():
+            try:
+                box["bytes"] = _groupagg_bytes(s, df)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                box["error"] = repr(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        # mid-map-stage: the 400ms per-map delay guarantees the victim has
+        # in-flight work when the drain-crash kills it, so the blackbox
+        # carries the full executor_drain → executor_down → recovery chain
+        time.sleep(0.25)
+        s.retire_executor("rdt-executor-scale-chaos-2")
+        t.join(timeout=600)
+        deadline = time.time() + 30
+        while time.time() < deadline \
+                and client.stats()["num_objects"] != before:
+            time.sleep(0.25)
+        orphans = client.stats()["num_objects"] - before
+        report = s.engine.shuffle_stage_report()
+        # the postmortem evidence chain: harvest every process's ring into
+        # a blackbox bundle and read the drain/recovery sequence back
+        bundle_path = metrics.write_blackbox("chaos-scale")
+        with open(bundle_path) as fh:
+            bundle = json.load(fh)
+        driver_events = [e["kind"]
+                         for e in bundle["processes"]["driver"]["events"]]
+        record = {
+            "failed_action": box.get("error"),
+            "byte_identical": box.get("bytes") == base,
+            "orphans": orphans,
+            "pool_size_after": len(s.executors),
+            "pipelined": any(e.get("pipelined") for e in report),
+            "recovered": sum(e.get("recovered", 0) for e in report),
+            "regenerated": sum(e.get("regenerated", 0) for e in report),
+            "crash_fired": os.path.exists(sentinels[0]),
+            "drop_fired": os.path.exists(sentinels[1]),
+            "blackbox": bundle_path,
+            "blackbox_has_drain": "executor_drain" in driver_events,
+            "blackbox_has_executor_down": "executor_down" in driver_events,
+            "blackbox_has_recovery_round": "recovery_round" in driver_events,
+        }
+    finally:
+        raydp_tpu.stop()
+        for k in ("RDT_ETL_AQE", "RDT_SHUFFLE_PIPELINE", "RDT_FAULTS"):
+            os.environ.pop(k, None)
+        for p in sentinels:
+            if os.path.exists(p):
+                os.remove(p)
+    print(f"[chaos-scale] identical={record['byte_identical']} "
+          f"orphans={record['orphans']} recovered={record['recovered']} "
+          f"blackbox={os.path.basename(bundle_path)}")
+    return record
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI contract: small load, asserts, writes to /tmp")
+    ap.add_argument("--out", default=None, help="record path override")
+    args = ap.parse_args()
+    out = args.out or ("/tmp/SCALE_SMOKE.json" if args.smoke else
+                       os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                    "SCALE.json"))
+    record = {
+        "bench": "scale_bench",
+        "smoke": args.smoke,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "configs": {
+            "autoscale": run_autoscale_config(args.smoke),
+            "chaos_scale": run_chaos_scale_config(args.smoke),
+        },
+    }
+    with open(out, "w") as fh:
+        json.dump(record, fh, indent=2, sort_keys=True)
+    print(f"record written to {out}")
+
+    auto = record["configs"]["autoscale"]
+    chaos = record["configs"]["chaos_scale"]
+    # the contract holds for the recorded artifact too, not just CI
+    assert auto["failed_actions"] == 0, auto
+    assert auto["results_identical"], auto
+    assert auto["grew"] and auto["shrank_to_min"], auto
+    assert chaos["failed_action"] is None, chaos
+    assert chaos["byte_identical"], chaos
+    assert chaos["orphans"] == 0, chaos
+    assert chaos["pipelined"], chaos
+    assert chaos["crash_fired"] and chaos["drop_fired"], chaos
+    assert chaos["recovered"] >= 1, chaos
+    assert chaos["blackbox_has_drain"], chaos
+    assert chaos["blackbox_has_executor_down"], chaos
+    assert chaos["blackbox_has_recovery_round"], chaos
+    print("scale bench contract: OK")
+
+
+if __name__ == "__main__":
+    main()

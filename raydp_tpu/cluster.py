@@ -175,11 +175,19 @@ class EtlCluster(Cluster):
         )
         self.workers.append(handle)
 
-    def remove_worker(self) -> Optional[ActorHandle]:
-        """Shrink by one (newest first) — dynamic allocation's kill side."""
+    def remove_worker(self, handle: Optional[ActorHandle] = None
+                      ) -> Optional[ActorHandle]:
+        """Shrink by one — dynamic allocation's kill side. ``handle`` picks
+        a specific worker (the graceful-drain reap path); default is the
+        newest."""
         if not self.workers:
             return None
-        handle = self.workers.pop()
+        if handle is None:
+            handle = self.workers.pop()
+        elif handle in self.workers:
+            self.workers.remove(handle)
+        else:
+            return None
         self._num_nodes = max(0, self._num_nodes - 1)
         try:
             handle.kill(no_restart=True)

@@ -28,7 +28,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
-from raydp_tpu import knobs, metrics, profiler
+from raydp_tpu import faults, knobs, metrics, profiler
 from raydp_tpu.etl import optimizer as O
 from raydp_tpu.etl import plan as P
 from raydp_tpu.etl import tasks as T
@@ -422,6 +422,11 @@ class ExecutorPool:
                  hosts_by_name: Optional[Dict[str, str]] = None):
         if not executors:
             raise ValueError("executor pool is empty")
+        # membership is ELASTIC (drain/retire + autoscale): ``executors``,
+        # ``_idents``, ``_ident_of``, ``by_name`` and the host maps are
+        # immutable snapshots REPLACED atomically under ``_lock`` on every
+        # membership change — readers that grabbed the old list keep a
+        # consistent view, and no reader needs the lock
         self.executors = list(executors)
         self.by_name = {h.name: h for h in executors}
         self.max_task_retries = max_task_retries
@@ -442,6 +447,20 @@ class ExecutorPool:
         self._rr = 0  # guarded-by: _lock
         self._local_rr: Dict[str, int] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
+        #: pool-WIDE in-flight per ident, across every concurrent run_tasks
+        #: call — the drain protocol's quiesce signal and the autoscaler's
+        #: busy signal (per-call caps still use each call's local counters)
+        self._busy: Dict[str, int] = {}  # guarded-by: _lock
+        #: ident → monotonic time marked unreachable. Pool-level (not
+        #: per-call) so every concurrent stage shares the discovery, and a
+        #: restart re-admission (mark_up) is observable session-wide
+        self._down: Dict[str, float] = {}  # guarded-by: _lock
+        #: ident → monotonic drain start; a draining executor accepts NO new
+        #: dispatch but keeps its in-flight tasks until they finish/fail
+        self._draining: Dict[str, float] = {}  # guarded-by: _lock
+        #: outstanding tasks across all active run_tasks calls (queued +
+        #: in-flight); demand - busy = the autoscaler's queue-depth signal
+        self._demand = 0  # guarded-by: _lock
 
     @staticmethod
     def _executor_ident(h) -> str:
@@ -458,6 +477,208 @@ class ExecutorPool:
             h = self.executors[self._rr % len(self.executors)]
             self._rr += 1
             return h
+
+    # ---- elastic membership -------------------------------------------------
+    def _swap_members(self, executors: List[ActorHandle],
+                      hosts_by_name: Dict[str, str]) -> None:
+        """Rebuild and atomically replace every membership snapshot.
+        Caller holds ``_lock``."""
+        idents = [self._executor_ident(h) for h in executors]
+        names_by_host: Dict[str, List[str]] = {}
+        for h in executors:
+            if h.name and h.name in hosts_by_name:
+                names_by_host.setdefault(hosts_by_name[h.name], []) \
+                    .append(h.name)
+        self.executors = executors
+        self._idents = idents
+        self._ident_of = {id(h): i for h, i in zip(executors, idents)}
+        self.by_name = {h.name: h for h in executors}
+        self.hosts_by_name = hosts_by_name
+        self._names_by_host = names_by_host
+
+    def add_executor(self, handle: ActorHandle,
+                     host_id: Optional[str] = None) -> str:
+        """Admit a new executor into rotation (autoscale grow / manual
+        attach); returns its scheduling ident. Stages already running pick
+        it up on their next dispatch pass."""
+        with self._lock:
+            if any(h is handle for h in self.executors):
+                return self._ident_of[id(handle)]
+            hosts = dict(self.hosts_by_name)
+            if handle.name and host_id is not None:
+                hosts[handle.name] = host_id
+            self._swap_members(self.executors + [handle], hosts)
+            ident = self._ident_of[id(handle)]
+            # a re-added name sheds any stale down/drain state
+            self._down.pop(ident, None)
+            self._draining.pop(ident, None)
+            size = len(self.executors) - len(self._draining)
+        metrics.set_gauge("pool_size", size)
+        logger.info("executor %s joined the pool (size %d)",
+                    handle.name or ident, size)
+        return ident
+
+    def remove_executor(self, name: str) -> Optional[ActorHandle]:
+        """Drop an executor from every membership snapshot (the last step of
+        a drain — or an abrupt removal; in-flight attempts on it simply fail
+        and retry elsewhere). Returns the removed handle, or None."""
+        with self._lock:
+            handle = self.by_name.get(name)
+            if handle is None:
+                return None
+            ident = self._ident_of[id(handle)]
+            rest = [h for h in self.executors if h is not handle]
+            hosts = {n: hid for n, hid in self.hosts_by_name.items()
+                     if n != name}
+            self._swap_members(rest, hosts)
+            self._draining.pop(ident, None)
+            self._down.pop(ident, None)
+            self._busy.pop(ident, None)
+            size = len(self.executors) - len(self._draining)
+        metrics.set_gauge("pool_size", size)
+        logger.info("executor %s left the pool (size %d)", name, size)
+        return handle
+
+    def begin_drain(self, name: str) -> bool:
+        """Take ``name`` out of dispatch rotation without touching its
+        in-flight tasks. False when unknown or already draining; raises when
+        the drain would leave zero live executors (the pool would wedge)."""
+        with self._lock:
+            handle = self.by_name.get(name)
+            if handle is None:
+                return False
+            ident = self._ident_of[id(handle)]
+            if ident in self._draining:
+                return False
+            live = [i for i in self._idents if i not in self._draining]
+            if len(live) <= 1:
+                raise ValueError(
+                    f"cannot drain {name!r}: it is the last live executor")
+            self._draining[ident] = time.monotonic()
+            size = len(self.executors) - len(self._draining)
+        metrics.set_gauge("pool_size", size)
+        return True
+
+    def cancel_drain(self, name: str) -> None:
+        """Put a draining executor back into rotation (a failed retirement
+        must not leave it unreachable-by-scheduler forever)."""
+        with self._lock:
+            handle = self.by_name.get(name)
+            if handle is None:
+                return
+            self._draining.pop(self._ident_of[id(handle)], None)
+            size = len(self.executors) - len(self._draining)
+        metrics.set_gauge("pool_size", size)
+
+    def wait_idle(self, name: str, timeout: float) -> bool:
+        """Block until ``name`` has zero pool-wide in-flight tasks (its
+        drain quiesce point) or ``timeout`` lapses; True = quiesced. An
+        executor that crashed mid-drain quiesces too — its attempts fail
+        and their completions decrement the same counter."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        while True:
+            with self._lock:
+                handle = self.by_name.get(name)
+                if handle is None:
+                    return True
+                busy = self._busy.get(self._ident_of[id(handle)], 0)
+            if busy <= 0:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+
+    def load(self) -> Dict[str, Any]:
+        """Scheduling-load snapshot for the autoscale controller: member /
+        live counts, pool-wide busy, queued demand (outstanding tasks not in
+        flight), and per-executor busy by display name."""
+        now = time.monotonic()
+        with self._lock:
+            members = list(zip(self.executors, self._idents))
+            busy = dict(self._busy)
+            draining = set(self._draining)
+            down = {i for i, t in self._down.items()
+                    if now - t < _DOWN_TTL_S}
+            demand = self._demand
+        live = [i for _, i in members if i not in draining]
+        busy_total = sum(busy.get(i, 0) for i in live)
+        return {
+            "size": len(members),
+            "live": len(live),
+            "down": len(down & set(live)),
+            "draining": len(draining),
+            "busy": busy_total,
+            "queued": max(0, demand - sum(busy.values())),
+            "per_executor_busy": {
+                (h.name or i): busy.get(i, 0) for h, i in members},
+        }
+
+    def draining_names(self) -> List[str]:
+        with self._lock:
+            draining = set(self._draining)
+            return [h.name or i for h, i in zip(self.executors, self._idents)
+                    if i in draining]
+
+    def _dispatch_view(self) -> Tuple[List[Tuple[ActorHandle, str]], set]:
+        """One-lock snapshot for a dispatch pass: dispatchable (handle,
+        ident) pairs (draining members excluded) plus the set of
+        currently-down idents — the scheduling hot loops evaluate
+        membership/downness against this copy instead of taking the pool
+        lock once per member per pass."""
+        now = time.monotonic()
+        with self._lock:
+            draining = self._draining
+            members = [(h, i) for h, i in zip(self.executors, self._idents)
+                       if i not in draining]
+            down = {i for i, t in self._down.items()
+                    if now - t < _DOWN_TTL_S}
+        return members, down
+
+    def _is_down(self, ident: str) -> bool:
+        with self._lock:
+            t = self._down.get(ident)
+        return t is not None and time.monotonic() - t < _DOWN_TTL_S
+
+    def _mark_down(self, ident: str, name: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            t = self._down.get(ident)
+            # transition computed under the SAME lock as the write: two
+            # concurrent stages discovering one crash must record one
+            # executor_down, not flood the bounded ring with duplicates
+            transition = t is None or now - t >= _DOWN_TTL_S
+            self._down[ident] = now
+        if transition:
+            # record the TRANSITION, not every probe of an already-down
+            # executor — a 60s unreachable grace of backoff probes must
+            # not flood the bounded flight-recorder ring
+            metrics.inc("sched_executor_down_total", label=name)
+            metrics.record_event("executor_down", executor=name)
+
+    def _mark_up(self, ident: str, name: str) -> None:
+        """A down-marked executor answered: re-admit it immediately (no TTL
+        wait) and record the symmetric executor_up event, so a node-agent
+        restart mid-action returns the pool to full width instead of the
+        action finishing on the shrunken remainder."""
+        with self._lock:
+            was_down = self._down.pop(ident, None)
+        if was_down is not None:
+            metrics.inc("sched_executor_up_total", label=name)
+            metrics.record_event("executor_up", executor=name)
+            logger.info("executor %s is reachable again; re-admitted to "
+                        "task placement", name)
+
+    def _busy_delta(self, ident: str, n: int) -> None:
+        with self._lock:
+            cur = self._busy.get(ident, 0) + n
+            if cur > 0:
+                self._busy[ident] = cur
+            else:
+                self._busy.pop(ident, None)
+
+    def _demand_delta(self, n: int) -> None:
+        with self._lock:
+            self._demand = max(0, self._demand + n)
 
     def multi_host(self) -> bool:
         """True when executors span machines — only then is locality routing
@@ -521,7 +742,9 @@ class ExecutorPool:
         attempts = [0] * n
         cap = max(1, max_inflight_per_executor)
         pending: Dict[Any, _Attempt] = {}
-        inflight: Dict[str, int] = {ident: 0 for ident in self._idents}
+        # per-CALL in-flight (the cap + busy-peak stats are per stage);
+        # membership is elastic, so entries appear as executors are chosen
+        inflight: Dict[str, int] = {}
         busy_peak: Dict[str, int] = {}
         copies = [0] * n             # live in-flight attempts per task
         retry_q: List[Tuple[float, int]] = []  # (due monotonic, task index)
@@ -541,30 +764,23 @@ class ExecutorPool:
         blobs: List[Optional[bytes]] = list(payloads) if payloads is not None \
             else [None] * n
 
-        down: Dict[str, float] = {}  # ident -> monotonic time marked down
         uprobe = [0] * n             # unreachable-submit probes per task
         unreach_since: List[Optional[float]] = [None] * n
-
-        def _is_down(ident: str) -> bool:
-            t = down.get(ident)
-            return t is not None and time.monotonic() - t < _DOWN_TTL_S
-
-        def _mark_down(ident: str, name: str) -> None:
-            if not _is_down(ident):
-                # record the TRANSITION, not every probe of an already-down
-                # executor — a 60s unreachable grace of backoff probes must
-                # not flood the bounded flight-recorder ring
-                metrics.inc("sched_executor_down_total", label=name)
-                metrics.record_event("executor_down", executor=name)
-            down[ident] = time.monotonic()
+        # down tracking lives on the POOL (shared across concurrent stages;
+        # a node-agent restart re-admits via _mark_up on the first answer)
+        _mark_down = self._mark_down
 
         def _any_capacity() -> bool:
-            any_live = live_free = False
-            for ident in self._idents:
-                if not _is_down(ident):
+            members, down = self._dispatch_view()
+            any_live = live_free = probe_free = False
+            for _h, ident in members:
+                busy = inflight.get(ident, 0)
+                if ident not in down:
                     any_live = True
-                    if inflight[ident] < cap:
+                    if busy < cap:
                         live_free = True
+                elif busy < cap:
+                    probe_free = True
             if any_live:
                 # a live executor at cap is BUSY, not gone: tasks wait for a
                 # slot instead of probing a dead address (which would burn
@@ -573,54 +789,71 @@ class ExecutorPool:
             # every executor is down: free slots on them count — probing is
             # the only way to notice a restart (the down TTL expires and the
             # submit itself is the probe)
-            return any(inflight[ident] < cap for ident in self._idents)
+            return probe_free
 
         def _choose(i: int, exclude: Optional[str] = None,
                     probe: bool = True):
             """(handle, ident) to run task ``i`` on: the preferred executor
-            whenever it is live and below its cap — on EVERY attempt, so a
-            transient failure no longer strands a cache-local task on remote
-            hosts for the rest of its retries — else the least-loaded live
-            executor below cap (round-robin tiebreak). When every executor
-            is down, a second pass (``probe=True``) returns a
-            down-but-below-cap executor so the submit itself probes for a
-            restart — but ONLY then: a live executor at its cap means the
-            task should wait for a slot, not accrue unreachable grace
-            against a dead address while the pool is merely busy;
-            (None, None) = nothing to submit to right now."""
+            whenever it is live, not draining, and below its cap — on EVERY
+            attempt, so a transient failure no longer strands a cache-local
+            task on remote hosts for the rest of its retries — else the
+            least-loaded live executor below cap (round-robin tiebreak).
+            Membership is read fresh per call: an executor the autoscaler
+            added mid-stage is dispatchable at once, a draining/removed one
+            never is. When every executor is down, a second pass
+            (``probe=True``) returns a down-but-below-cap executor so the
+            submit itself probes for a restart — but ONLY then: a live
+            executor at its cap means the task should wait for a slot, not
+            accrue unreachable grace against a dead address while the pool
+            is merely busy; (None, None) = nothing to submit to right now."""
+            members, down = self._dispatch_view()
+            member_idents = {ident for _h, ident in members}
             if preferred is not None and preferred[i] is not None:
                 h = self.by_name.get(preferred[i])
                 if h is not None:
-                    ident = self._ident_of[id(h)]
-                    if ident != exclude and not _is_down(ident) \
-                            and inflight[ident] < cap:
+                    ident = self._ident_of.get(id(h))
+                    if ident is not None and ident in member_idents \
+                            and ident != exclude and ident not in down \
+                            and inflight.get(ident, 0) < cap:
                         return h, ident
-            k = len(self.executors)
+            k = len(members)
+            if k == 0:
+                return None, None
             with self._lock:
                 start = self._rr
                 self._rr += 1
-            may_probe = probe and not any(not _is_down(ident)
-                                          for ident in self._idents)
+            may_probe = probe and all(ident in down
+                                      for _h, ident in members)
             best = None
             for allow_down in (False, True) if may_probe else (False,):
                 for off in range(k):
-                    j = (start + off) % k
-                    ident = self._idents[j]
-                    if ident == exclude or inflight[ident] >= cap:
+                    h, ident = members[(start + off) % k]
+                    busy = inflight.get(ident, 0)
+                    if ident == exclude or busy >= cap:
                         continue
-                    if _is_down(ident) != allow_down:
+                    if (ident in down) != allow_down:
                         continue
-                    if best is None or inflight[ident] < best[2]:
-                        best = (self.executors[j], ident, inflight[ident])
+                    if best is None or busy < best[2]:
+                        best = (h, ident, busy)
                 if best is not None:
                     break
             if best is None:
                 return None, None
             return best[0], best[1]
 
+        # pool-wide accounting (drain quiesce + autoscale signals), reconciled
+        # in the final ``finally`` so an abort/abandonment can never leak a
+        # phantom busy count or queued demand
+        pool_acct: Dict[str, int] = {}
+
+        def _pool_busy(ident: str, d: int) -> None:
+            pool_acct[ident] = pool_acct.get(ident, 0) + d
+            self._busy_delta(ident, d)
+
         def _register(fut, i: int, ident: str, name: str, backup: bool):
             pending[fut] = _Attempt(i, ident, name, time.monotonic(), backup)
-            inflight[ident] += 1
+            inflight[ident] = inflight.get(ident, 0) + 1
+            _pool_busy(ident, +1)
             copies[i] += 1
             busy_peak[name] = max(busy_peak.get(name, 0), inflight[ident])
             metrics.inc("sched_tasks_dispatched_total", label=name)
@@ -660,6 +893,9 @@ class ExecutorPool:
                 return
             unreach_since[i] = None
             uprobe[i] = 0
+            # the submit reached it: a down-marked executor (a restart the
+            # node agent finished mid-action) re-enters placement now
+            self._mark_up(ident, handle.name or ident)
             _register(fut, i, ident, handle.name or ident, False)
 
         def _maybe_speculate(now: float) -> Optional[float]:
@@ -701,6 +937,10 @@ class ExecutorPool:
                             handle.name or ident, age, med)
             return next_due
 
+        # queued-demand signal for the autoscaler: outstanding tasks of this
+        # call, decremented as each is decided, reconciled in the finally
+        self._demand_delta(n)
+        demand_left = n
         try:
             while next_idx < n and _any_capacity():
                 _submit(next_idx)
@@ -733,14 +973,26 @@ class ExecutorPool:
                 if spec_due is not None:
                     timeout = spec_due if timeout is None \
                         else min(timeout, spec_due)
+                if timeout is None and (next_idx < n or retry_q):
+                    # work is queued: wake on a bounded poll so a capacity
+                    # change the futures cannot signal — an executor the
+                    # autoscaler just admitted, or a down TTL expiring —
+                    # is dispatched to promptly, not after the next
+                    # (possibly minutes-long) in-flight completion
+                    timeout = 0.25
                 done, _ = wait(list(pending.keys()), timeout=timeout,
                                return_when=FIRST_COMPLETED)
                 for fut in done:
                     at = pending.pop(fut)
                     i = at.i
-                    inflight[at.ident] -= 1
+                    inflight[at.ident] = inflight.get(at.ident, 1) - 1
+                    _pool_busy(at.ident, -1)
                     copies[i] -= 1
                     err = fut.exception()
+                    if err is None:
+                        # the executor answered: whatever marked it down is
+                        # over — re-admit it to placement at once
+                        self._mark_up(at.ident, at.name)
                     if results[i] is not None:
                         # a duplicate of an already-decided task: the
                         # speculation loser — drain it, free its outputs
@@ -753,6 +1005,8 @@ class ExecutorPool:
                         r = fut.result()
                         results[i] = r
                         done_cnt += 1
+                        demand_left -= 1
+                        self._demand_delta(-1)
                         durations.append(time.monotonic() - at.started)
                         if on_result is not None:
                             try:
@@ -827,28 +1081,39 @@ class ExecutorPool:
             # cancel queued retries, drain in-flight tasks, free outputs
             self._abort_stage(pending, results, retry_q)
             raise
-        # every task is decided; losing duplicates may still be running —
-        # do NOT wait for them (that would hand the straggler back its
-        # hostage). Whenever each one lands, its outputs are freed and a
-        # late cache-put dropped through the loser path.
-        for fut, at in list(pending.items()):
-            winner = results[at.i]
-            fut.add_done_callback(
-                lambda f, w=winner: self._free_loser_result(f, w))
-        pending.clear()
-        if speculated:
-            metrics.inc("sched_speculated_total", len(speculated))
-        if spec_won:
-            metrics.inc("sched_speculation_won_total", spec_won)
-        if sched_stats is not None:
-            sched_stats["speculated"] = \
-                sched_stats.get("speculated", 0) + len(speculated)
-            sched_stats["speculation_won"] = \
-                sched_stats.get("speculation_won", 0) + spec_won
-            peb = sched_stats.setdefault("per_executor_busy", {})
-            for name, peak in busy_peak.items():
-                peb[name] = max(peb.get(name, 0), peak)
-        return results  # type: ignore[return-value]
+        else:
+            # every task is decided; losing duplicates may still be running —
+            # do NOT wait for them (that would hand the straggler back its
+            # hostage). Whenever each one lands, its outputs are freed and a
+            # late cache-put dropped through the loser path.
+            for fut, at in list(pending.items()):
+                winner = results[at.i]
+                fut.add_done_callback(
+                    lambda f, w=winner: self._free_loser_result(f, w))
+            pending.clear()
+            if speculated:
+                metrics.inc("sched_speculated_total", len(speculated))
+            if spec_won:
+                metrics.inc("sched_speculation_won_total", spec_won)
+            if sched_stats is not None:
+                sched_stats["speculated"] = \
+                    sched_stats.get("speculated", 0) + len(speculated)
+                sched_stats["speculation_won"] = \
+                    sched_stats.get("speculation_won", 0) + spec_won
+                peb = sched_stats.setdefault("per_executor_busy", {})
+                for name, peak in busy_peak.items():
+                    peb[name] = max(peb.get(name, 0), peak)
+            return results  # type: ignore[return-value]
+        finally:
+            # reconcile the pool-wide signals whatever path exits: attempts
+            # still counted (losers left running, drain-abandoned
+            # stragglers) stop counting as busy, and this call's undecided
+            # demand is withdrawn — a failed stage must read as idle, not
+            # as a queue the autoscaler keeps growing for
+            self._demand_delta(-demand_left)
+            for ident, k in pool_acct.items():
+                if k:
+                    self._busy_delta(ident, -k)
 
     def _drain_merge(self, pending: Dict[Any, "_Attempt"],
                      results: List[Optional[Dict[str, Any]]],
@@ -1121,6 +1386,83 @@ class Engine:
     def reset_shuffle_stage_report(self) -> None:
         with self._report_lock:
             self._stage_reports.clear()
+
+    # ---- elastic pool: graceful drain ---------------------------------------
+    def retire_executor(self, name: str, rehome=None, reap=None,
+                        timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Gracefully drain one executor out of the pool (doc/etl.md
+        "Elastic executor pool"; doc/fault_tolerance.md "Scale events").
+
+        Protocol: (1) the scheduler stops routing new dispatches to it
+        (:meth:`ExecutorPool.begin_drain`); (2) its in-flight tasks finish —
+        or, if it dies mid-drain, fail and re-queue onto survivors through
+        the ordinary retry/recovery machinery — bounded by
+        ``RDT_DRAIN_TIMEOUT_S``; (3) its executor-RAM state is either
+        re-homed (``RDT_DRAIN_REHOME=1``: the caller's ``rehome(name)`` hook
+        rebuilds cached blocks on survivors from their lineage recipes) or
+        deliberately abandoned to on-read lineage recovery; (4) it leaves
+        every membership snapshot; (5) the caller's ``reap(handle)`` hook
+        kills the process (through the node agent on remote nodes). Store
+        blobs are machine-homed, not executor-homed, so the drain never
+        moves store payloads — a mid-stream pipelined shuffle keeps its
+        sealed generations, and a crash mid-drain re-seals via recovery.
+
+        The ``pool.drain`` fault site fires here (key: executor name);
+        action ``crash`` kills the RETIRING executor abruptly mid-drain —
+        the chaos model for scale-down racing live work."""
+        handle = self.pool.by_name.get(name)
+        if handle is None:
+            raise KeyError(f"unknown executor {name!r}")
+        if timeout is None:
+            timeout = float(knobs.get("RDT_DRAIN_TIMEOUT_S"))
+        if not self.pool.begin_drain(name):
+            raise ValueError(f"executor {name!r} is already draining")
+        metrics.inc("pool_drains_total")
+        metrics.record_event("executor_drain", executor=name)
+        logger.info("draining executor %s out of the pool", name)
+        try:
+            rule = faults.check("pool.drain", key=name)
+            if rule is not None:
+                if rule.action == "crash":
+                    # the RETIRING executor dies mid-drain (scale-down
+                    # racing recovery/streams) — never this driver process.
+                    # submit, not call: the process exits before replying
+                    try:
+                        handle.submit("crash")
+                    except Exception:
+                        pass
+                else:
+                    faults.apply(rule, "pool.drain")
+            quiesced = self.pool.wait_idle(name, timeout)
+            if not quiesced:
+                logger.warning(
+                    "executor %s still busy after the %.0fs drain window; "
+                    "abandoning its in-flight tasks to retry/recovery",
+                    name, timeout)
+            rehomed = 0
+            if rehome is not None and bool(knobs.get("RDT_DRAIN_REHOME")):
+                try:
+                    rehomed = int(rehome(name) or 0)
+                except Exception:
+                    # abandonment is always safe: a cached block that never
+                    # re-homed rebuilds from its recipe on the next read
+                    logger.warning("drain re-home for %s failed; its blocks "
+                                   "recover through lineage on read", name,
+                                   exc_info=True)
+        except BaseException:
+            # a failed retirement must not leave the executor unreachable
+            # by the scheduler forever
+            self.pool.cancel_drain(name)
+            raise
+        self.pool.remove_executor(name)
+        if reap is not None:
+            try:
+                reap(handle)
+            except Exception:
+                logger.warning("reap of drained executor %s failed", name,
+                               exc_info=True)
+        return {"executor": name, "quiesced": quiesced, "rehomed": rehomed,
+                "pool_size": len(self.pool.executors)}
 
     @staticmethod
     def _optimized(node: P.PlanNode) -> P.PlanNode:

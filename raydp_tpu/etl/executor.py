@@ -459,6 +459,37 @@ class EtlExecutor:
         ref = get_client().put_arrow(table, owner=owner)
         return {"ref": ref, "num_rows": table.num_rows}
 
+    def warm_block(self, cache_key: str,
+                   recover_bytes: Optional[bytes] = None) -> bool:
+        """Pre-populate this executor's block cache — the graceful-drain
+        re-homing path: a retiring executor's cached partition is rebuilt
+        HERE from its lineage recipe (which reads the frame's pinned store
+        blobs through the ranged-fetch plane) before the retiree is reaped,
+        so later cache-local reads never pay the on-miss rebuild. Unlike
+        :meth:`get_block`, nothing is written to the object store. True
+        when the block is cached afterwards."""
+        if self.cache.get(cache_key) is not None:
+            return True
+        if recover_bytes is None:
+            return False
+        task: T.Task = cloudpickle.loads(recover_bytes)
+        table = T.run_task_body(task)
+        self.cache.put(cache_key, table)
+        return True
+
+    def drain_info(self) -> Dict[str, Any]:
+        """What this executor uniquely holds in process RAM — the drain
+        protocol's inventory (cached blocks to re-home, serving replicas to
+        re-route) and the scale bench's audit surface."""
+        from raydp_tpu.serve import replica as serve_replica
+        return {
+            "executor": self._actor_name,
+            "blocks": self.cache.keys(),
+            "block_bytes": self.cache.total_bytes(),
+            "replicas": sorted(r.get("replica", "")
+                               for r in serve_replica.stats()["replicas"]),
+        }
+
     def has_block(self, cache_key: str) -> bool:
         return self.cache.get(cache_key) is not None
 

@@ -11,6 +11,7 @@ alive so converted datasets survive the ETL engine, exactly like
 
 from __future__ import annotations
 
+import threading
 import uuid
 from typing import Dict, List, Optional, Union
 
@@ -45,6 +46,13 @@ class Session:
         self.engine: Optional[Engine] = None
         self._cached_frames: Dict[str, P.CachedScan] = {}
         self._stopped = False
+        self._autoscaler = None  # PoolAutoscaler once autoscale() is asked for
+        #: serializes EVERY scale operation — manual request_total_executors,
+        #: retire_executor, and the autoscaler's grow/shrink — so two racing
+        #: ops can never read cluster.workers[-1] for each other's spawn or
+        #: pick the same drain victim. Reentrant: request_total_executors
+        #: holds it around the per-executor ops that also take it.
+        self._scale_lock = threading.RLock()
 
     @property
     def executors(self) -> List[ActorHandle]:
@@ -114,36 +122,172 @@ class Session:
             pass
         return hosts
 
-    # ---- dynamic allocation -------------------------------------------------
+    # ---- dynamic allocation / elastic pool ----------------------------------
     def request_total_executors(self, total: int) -> int:
         """Scale the executor gang to ``total`` live executors.
 
         Parity: Spark dynamic allocation routed to actor create/kill —
         ``doRequestTotalExecutors`` / ``doKillExecutors``
         (RayCoarseGrainedSchedulerBackend.scala:278-301, RayAppMaster.scala:
-        173-190, 275-288). Shrinking kills the newest executors (their cached
-        blocks recover through lineage on the survivors)."""
+        173-190, 275-288). Shrinking DRAINS the newest executors gracefully
+        (:meth:`retire_executor`: out of rotation, in-flight work finishes,
+        cached blocks re-home or abandon to lineage, then the process is
+        reaped); growing spawns through the ordinary launch path and admits
+        each executor into the live pool once ready."""
         if total < 1:
             raise ValueError("need at least one executor")
-        while len(self.executors) > total:
-            self.cluster.remove_worker()
-        added = []
-        while len(self.executors) < total:
-            added.append(self._launch_executor(block=False))
-        for h in added:
-            h.wait_ready()
-        if self.engine is not None:
-            self.engine.pool = ExecutorPool(
-                self.executors, hosts_by_name=self._executor_hosts())
+        from raydp_tpu import knobs
+        with self._scale_lock:
+            while len(self.executors) > total:
+                victim = self._shrink_candidate()
+                if victim is None:
+                    break
+                self.retire_executor(victim)
+            # grow in PARALLEL: launch every missing executor non-blocking
+            # first, then absorb their warm-ups concurrently through the
+            # readiness probes (serial spawn+wait would pay the jax import
+            # storm once per executor)
+            need = total - len(self.executors)
+            launched = [self._launch_executor(block=False)
+                        for _ in range(need)]
+            wait_s = float(knobs.get("RDT_EXECUTOR_WAIT_S"))
+            ready, failures = [], []
+            for h in launched:
+                try:
+                    h.wait_ready(timeout=wait_s)
+                    ready.append(h)
+                except Exception as e:  # noqa: BLE001 - reaped + re-raised
+                    # a half-started worker is reaped, never admitted — and
+                    # never left as an invisible member a later scale call
+                    # would count but the scheduler never dispatches to
+                    failures.append((h, e))
+                    self.cluster.remove_worker(h)
+            hosts = self._executor_hosts()  # once, not per admission
+            if self.engine is not None:
+                for h in ready:
+                    self.engine.pool.add_executor(h,
+                                                  host_id=hosts.get(h.name))
+            if failures:
+                raise RuntimeError(
+                    f"{len(failures)}/{len(launched)} executors never "
+                    f"became ready during scale-up (first: "
+                    f"{failures[0][0].name})") from failures[0][1]
         logger.info("session %s scaled to %d executors", self.app_name,
                     len(self.executors))
         return len(self.executors)
+
+    def retire_executor(self, name: str) -> int:
+        """Gracefully drain executor ``name`` out of the session: scheduler
+        rotation stops, in-flight tasks finish (or re-queue through
+        retry/recovery), cached frame partitions re-home onto survivors
+        (``RDT_DRAIN_REHOME``) or abandon to their lineage recipes, and only
+        then is the process reaped (through its node agent on remote
+        nodes). Returns the new pool size."""
+        if self.engine is None:
+            raise RuntimeError("session is not started")
+        with self._scale_lock:
+            out = self.engine.retire_executor(
+                name, rehome=self._rehome_blocks,
+                reap=lambda h: self.cluster.remove_worker(h))
+        logger.info("session %s retired executor %s (pool %d, quiesced=%s, "
+                    "rehomed=%d)", self.app_name, name, out["pool_size"],
+                    out["quiesced"], out["rehomed"])
+        return out["pool_size"]
+
+    def autoscale(self, min_size: Optional[int] = None,
+                  max_size: Optional[int] = None):
+        """Start (or return) the pool's autoscale controller
+        (:class:`~raydp_tpu.etl.autoscale.PoolAutoscaler`): grows under
+        sustained queued demand up to ``max_size`` (default
+        ``RDT_POOL_MAX``), drains idle executors down to ``min_size``
+        (default ``RDT_POOL_MIN``), with hysteresis. Stopped by
+        :meth:`stop`."""
+        if self.engine is None:
+            raise RuntimeError("session is not started")
+        if self._autoscaler is None:
+            from raydp_tpu.etl.autoscale import PoolAutoscaler
+            self._autoscaler = PoolAutoscaler(
+                self, min_size=min_size, max_size=max_size).start()
+        elif min_size is not None or max_size is not None:
+            # a second call adjusts the LIVE controller's bounds (they are
+            # re-read every tick) instead of silently keeping the old caps
+            self._autoscaler.set_bounds(min_size=min_size, max_size=max_size)
+        return self._autoscaler
+
+    def _grow_executor(self):
+        """Spawn one executor and admit it to the live pool once the
+        ``RDT_EXECUTOR_WAIT_S`` readiness probe absorbs its warm-up; None
+        when the spawn or the probe fails (the half-started worker is
+        reaped, never admitted)."""
+        from raydp_tpu import knobs
+        with self._scale_lock:
+            try:
+                h = self._launch_executor(block=False)
+            except Exception:
+                logger.warning("executor spawn failed", exc_info=True)
+                return None
+            try:
+                h.wait_ready(timeout=float(knobs.get("RDT_EXECUTOR_WAIT_S")))
+            except Exception:
+                logger.warning("executor %s never became ready; reaping it",
+                               h.name, exc_info=True)
+                self.cluster.remove_worker(h)
+                return None
+            if self.engine is not None:
+                host = self._executor_hosts().get(h.name)
+                self.engine.pool.add_executor(h, host_id=host)
+            return h
+
+    def _shrink_candidate(self) -> Optional[str]:
+        """The newest non-draining executor — the reverse of spawn order,
+        like Spark's kill-newest dynamic allocation; None when only one
+        would remain."""
+        if self.engine is None:
+            return None
+        draining = set(self.engine.pool.draining_names())
+        names = [h.name for h in self.executors
+                 if h.name and h.name not in draining]
+        return names[-1] if len(names) > 1 else None
+
+    def _rehome_blocks(self, name: str) -> int:
+        """Drain re-homing: every cached frame partition homed on the
+        retiring executor is rebuilt on a survivor from its lineage recipe
+        (``warm_block`` reads the frame's pinned store blobs through the
+        ranged-fetch plane) and the frame's preferred-executor map is
+        repointed. Best-effort per block: a block that fails to re-home is
+        simply abandoned — the next read rebuilds it via ``CachedSource``
+        recovery. Returns the number of blocks re-homed."""
+        survivors = [h for h in self.executors if h.name and h.name != name]
+        if not survivors:
+            return 0
+        moved = 0
+        rr = 0
+        for cached in self._cached_frames.values():
+            for i, owner in enumerate(cached.executors):
+                if owner != name:
+                    continue
+                target = survivors[rr % len(survivors)]
+                rr += 1
+                try:
+                    target.call("warm_block", cached.cache_keys[i],
+                                cached.recover_tasks[i], timeout=120.0)
+                    cached.executors[i] = target.name
+                    moved += 1
+                except Exception:
+                    logger.warning(
+                        "re-home of block %s onto %s failed; it will "
+                        "rebuild on read", cached.cache_keys[i], target.name,
+                        exc_info=True)
+        return moved
 
     def stop(self, cleanup_data: bool = True) -> None:
         """Idempotent; a later ``stop(cleanup_data=True)`` after a keep-data stop
         still reaps the master (parity: ray_cluster_master.py:236-247)."""
         if not self._stopped:
             self._stopped = True
+            if self._autoscaler is not None:
+                self._autoscaler.stop()
+                self._autoscaler = None
             if self.cluster is not None:
                 self.cluster.stop(cleanup_master=False)
         if cleanup_data and self.master is not None:

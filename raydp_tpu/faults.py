@@ -20,7 +20,8 @@ Spec grammar (documented in doc/fault_tolerance.md)::
     rule       = site ':' action (':' key '=' value)*
 
     sites   : executor.run_task | shuffle.write | shuffle.fetch | store.get
-              | rpc.call | estimator.epoch | serve.predict
+              | rpc.call | estimator.epoch | serve.predict | pool.drain
+              | pool.scale
               (env specs must name a KNOWN_SITES entry)
     actions : crash | delay | raise | drop | connloss   (interpreted by the site)
     keys    : nth= every= p= times= seed= match= once= ms= ms_per_mb= bucket=
@@ -74,6 +75,8 @@ KNOWN_SITES = frozenset((
     "rpc.call",
     "estimator.epoch",
     "serve.predict",
+    "pool.drain",
+    "pool.scale",
 ))
 
 #: the site-specific actions and the only call sites that interpret them —

@@ -133,6 +133,32 @@ _ALL = [
     _k("RDT_SPECULATION_MIN_S", "float", 1.0, PER_ACTION, "etl",
        "Floor on the straggler threshold: sub-second stages never "
        "speculate."),
+    # ---- elastic executor pool ----------------------------------------------
+    _k("RDT_POOL_MIN", "int", 1, PER_ACTION, "etl",
+       "Autoscale floor: the controller never drains the pool below this "
+       "many live executors."),
+    _k("RDT_POOL_MAX", "int", 0, PER_ACTION, "etl",
+       "Autoscale ceiling: the controller never grows past this. 0 keeps "
+       "the pool fixed at its session size (autoscaling must be asked for "
+       "explicitly via Session.autoscale(max_size=...))."),
+    _k("RDT_POOL_SCALE_INTERVAL_S", "float", 1.0, PER_ACTION, "etl",
+       "Autoscale controller tick period (load is sampled once per tick)."),
+    _k("RDT_POOL_SCALE_UP_S", "float", 2.0, PER_ACTION, "etl",
+       "Sustained queue-depth window before the controller grows the pool "
+       "(a single recovery-induced spike never spawns an executor)."),
+    _k("RDT_POOL_IDLE_S", "float", 10.0, PER_ACTION, "etl",
+       "Sustained fully-idle window before the controller drains an "
+       "executor back out."),
+    _k("RDT_POOL_COOLDOWN_S", "float", 5.0, PER_ACTION, "etl",
+       "Hysteresis: no further scale decision for this long after any "
+       "grow/shrink event."),
+    _k("RDT_DRAIN_REHOME", "bool", True, PER_ACTION, "etl",
+       "Graceful drain re-homes a retiring executor's cached blocks onto "
+       "survivors (rebuilt from their lineage recipes); 0 abandons them to "
+       "on-read lineage recovery instead."),
+    _k("RDT_DRAIN_TIMEOUT_S", "float", 30.0, PER_ACTION, "etl",
+       "How long a drain waits for the retiring executor's in-flight tasks "
+       "before abandoning them to the normal retry/recovery machinery."),
     # ---- training / feed ----------------------------------------------------
     _k("RDT_PREFETCH_TO_DEVICE", "int", 2, PER_ACTION, "training",
        "Already-device_put batches the streaming feed keeps ahead of the "

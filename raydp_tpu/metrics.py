@@ -105,6 +105,18 @@ _ALL_METRICS = [
     _m("sched_executor_down_total", COUNTER, "1", "scheduler",
        "Times an executor was marked unreachable by task placement.",
        label="executor"),
+    _m("sched_executor_up_total", COUNTER, "1", "scheduler",
+       "Times a down-marked executor answered again and re-entered task "
+       "placement (the executor_down symmetry).", label="executor"),
+    _m("pool_size", GAUGE, "1", "scheduler",
+       "Live executors in the elastic pool (draining members excluded)."),
+    _m("pool_drains_total", COUNTER, "1", "scheduler",
+       "Graceful executor drains started (retire_executor / autoscale "
+       "scale-down)."),
+    _m("pool_scaled_up_total", COUNTER, "1", "scheduler",
+       "Executors the autoscale controller added to the pool."),
+    _m("pool_scaled_down_total", COUNTER, "1", "scheduler",
+       "Executors the autoscale controller drained out of the pool."),
     _m("recovery_rounds_total", COUNTER, "1", "recovery",
        "Lineage-recovery rounds that re-executed producers."),
     _m("recovery_blobs_regenerated_total", COUNTER, "1", "recovery",
@@ -253,6 +265,15 @@ _ALL_EVENTS = [
        "generation."),
     _e("executor_down", "scheduler",
        "Task placement marked an executor unreachable."),
+    _e("executor_up", "scheduler",
+       "A down-marked executor answered again and re-entered task "
+       "placement (restart re-admission; the executor_down symmetry)."),
+    _e("executor_drain", "scheduler",
+       "An executor began a graceful drain out of the pool (deliberate "
+       "retirement, never a crash)."),
+    _e("pool_scale", "scheduler",
+       "The autoscale controller grew or shrank the executor pool "
+       "(direction + resulting size)."),
     _e("stage_abort", "scheduler",
        "A failing stage ran the abort contract (drain + free)."),
     _e("action_failed", "engine",

@@ -79,6 +79,14 @@ class _RemoteProcess:
             return -1
         if code is not None:
             self._last_code = int(code)
+            # the exit is observed exactly once: have the agent harvest the
+            # zombie and drop its process-table entry, so an agent that
+            # scales executors up and down all day never accumulates dead
+            # entries (best-effort — a missed reap only leaks bookkeeping)
+            try:
+                self._agent.call("reap", self.pid, timeout=10.0)
+            except Exception:
+                pass
         return self._last_code
 
     def kill(self) -> None:

@@ -72,6 +72,15 @@ class NodeAgentService:
     def kill(self, pid: int) -> bool:
         return self._agent.kill(pid)
 
+    def reap(self, pid: int) -> Optional[int]:
+        """Kill ``pid`` (if still running) and, once it has exited, harvest
+        the zombie and drop it from the process table — the scale-down
+        reaper's bookkeeping twin of :meth:`kill`, which leaves a dead
+        entry behind forever. Returns the exit code, or None while the
+        process has not exited yet (callers poll; this handler never parks
+        a dispatcher waiting on an exit)."""
+        return self._agent.reap(pid)
+
     def list_pids(self) -> Dict[int, Optional[int]]:
         return {pid: self._agent.poll(pid) for pid in list(self._agent.procs)}
 
@@ -310,6 +319,23 @@ class NodeAgent:
             except ProcessLookupError:
                 pass
         return True
+
+    def reap(self, pid: int) -> Optional[int]:
+        """Kill + harvest: SIGKILL the group if still alive, then (without
+        blocking) poll; once exited, the Popen's poll() has waitpid'ed the
+        zombie and the table entry is dropped so a long-lived agent that
+        scales executors up and down all day never accumulates dead
+        entries. Returns the exit code, None while still exiting."""
+        with self._lock:
+            proc = self.procs.get(pid)
+        if proc is None:
+            return -1
+        self.kill(pid)
+        code = proc.poll()
+        if code is not None:
+            with self._lock:
+                self.procs.pop(pid, None)
+        return code
 
     # ---- lifecycle ----------------------------------------------------------
     def serve_forever(self) -> None:

@@ -213,6 +213,13 @@ class ServingSession:
             executors = list(session.executors)
         if not executors:
             raise ValueError("serving needs at least one executor")
+        #: the live-member view replica reloads route through: when the
+        #: executor hosting a replica is RETIRED from the pool (not merely
+        #: restarting), the background reload re-binds the replica onto a
+        #: surviving member instead of probing the corpse until the
+        #: re-route grace expires. None with an explicit executors= list
+        #: (no pool to consult — reloads then probe the fixed handle only).
+        self._session = session
         if num_replicas is not None:
             if num_replicas < 1:
                 raise ValueError("num_replicas must be >= 1")
@@ -664,9 +671,15 @@ class ServingSession:
 
     def _reload(self, rep: _ReplicaState) -> None:
         """Background: wait out the executor restart and reload the
-        servable, then hand the replica back to the dispatcher."""
+        servable, then hand the replica back to the dispatcher. Routed
+        through the pool's live-member view: an executor that was RETIRED
+        (drained out of the session) never comes back under its old handle,
+        so the replica re-binds onto a surviving member and loads there —
+        probing the corpse until the grace expired was exactly the
+        fixed-identity bug this replaces."""
         deadline = time.monotonic() + self._reroute_grace_s
         last: Optional[BaseException] = None
+        fails = 0
         while time.monotonic() < deadline:
             if self._closed:
                 return  # session gone: stop dialing a stopped runtime
@@ -678,10 +691,67 @@ class ServingSession:
                 return
             except Exception as e:  # noqa: BLE001 - keep probing the restart
                 last = e
+                fails += 1
+                if self._maybe_rebind(rep, fails):
+                    # fresh target: it earns its own probe allowance (a
+                    # carried-over count would ping-pong the replica
+                    # between live members on every failed probe)
+                    fails = 0
                 time.sleep(0.5)
         logger.error("replica %s did not come back within %.0fs: %s",
                      rep.rid, self._reroute_grace_s, last)
         self._events.put(("replica_up", rep, last))
+
+    def _live_executors(self) -> List:
+        """The owning session's current pool members (empty without one)."""
+        if self._session is None:
+            return []
+        try:
+            return [h for h in list(self._session.executors)
+                    if getattr(h, "name", None)]
+        except Exception:  # noqa: BLE001 - a stopping session reads as none
+            return []
+
+    def _maybe_rebind(self, rep: _ReplicaState, fails: int) -> bool:
+        """Re-home a reloading replica whose executor left the pool: once
+        the bound executor is no longer a live member (retired/reaped), or
+        keeps refusing while live alternatives exist, bind the replica to
+        the live member hosting the fewest replicas and let the reload loop
+        land it there (True = the binding changed). The dispatcher reads
+        ``rep.replica`` concurrently — a plain attribute swap, and either
+        handle is safe to dial (a lost submit re-routes through the
+        ordinary fault path)."""
+        live = self._live_executors()
+        if not live:
+            return False
+        names = {h.name for h in live}
+        still_member = rep.executor in names
+        # a live member may just be restarting in place: give it a few
+        # probes before abandoning locality; a NON-member never returns
+        if still_member and fails < 4:
+            return False
+        counts: Dict[str, int] = {}
+        for r in self._replicas:
+            counts[r.executor] = counts.get(r.executor, 0) + 1
+        target = min(live, key=lambda h: (counts.get(h.name, 0)
+                                          if h.name != rep.executor
+                                          else len(self._replicas) + 1))
+        if target.name == rep.executor:
+            return False
+        logger.warning("replica %s re-homing from %s executor %s to %s",
+                       rep.rid, "retired" if not still_member else "dead",
+                       rep.executor, target.name)
+        if still_member:
+            # abandoning a LIVE member (persistent refusals, e.g. a long
+            # GC pause): best-effort unload there, or a merely-unreachable
+            # process would keep the rid's servable weights in RAM forever
+            try:
+                rep.replica.call("serve_unload", rep.rid, timeout=10.0)
+            except Exception:  # noqa: BLE001 - it may really be dead
+                pass
+        rep.replica = target
+        rep.executor = target.name
+        return True
 
     def _on_replica_up(self, rep: _ReplicaState,
                        err: Optional[BaseException]) -> None:

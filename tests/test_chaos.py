@@ -17,6 +17,7 @@ sentinel exists — a schedule that never fired would silently test nothing).
 """
 
 import os
+import threading
 import time
 
 import numpy as np
@@ -864,6 +865,201 @@ def test_broadcast_speculation_losers_leave_no_orphans(tmp_path,
             f"broadcast speculation races orphaned {orphans} store objects")
     finally:
         raydp_tpu.stop()
+
+
+# ==== elastic pool under chaos (ISSUE 13) ==========================================
+def _session3(app):
+    return raydp_tpu.init(app, num_executors=3, executor_cores=1,
+                          executor_memory="512MB")
+
+
+def _collect_groupagg_during_retire(app, victim_suffix="-2",
+                                    retire_after_s=0.4):
+    """Start the canonical groupagg on a background thread, retire one
+    executor mid-action, join, and return (ipc bytes, report, session-level
+    facts). The session is fully torn down before returning."""
+    from raydp_tpu.runtime.object_store import get_client
+
+    s = _session3(app)
+    try:
+        df = _frame(s)
+        client = get_client()
+        before = client.stats()["num_objects"]
+        out = df.groupBy("k").agg(F.sum("v").alias("s"),
+                                  F.count("v").alias("n"))
+        box = {}
+
+        def run():
+            try:
+                box["table"] = s.engine.collect(out._plan) \
+                    .sort_by([("k", "ascending")])
+            except Exception as e:  # noqa: BLE001 - asserted below
+                box["error"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        time.sleep(retire_after_s)
+        victim = f"rdt-executor-{app}{victim_suffix}"
+        s.retire_executor(victim)
+        t.join(timeout=300)
+        assert not t.is_alive(), "action wedged across the retirement"
+        if "error" in box:
+            raise box["error"]
+        # store-count audit: the drain + recovery leave zero orphans
+        # (late losers/regenerations free asynchronously: poll)
+        deadline = time.time() + 30
+        while time.time() < deadline \
+                and client.stats()["num_objects"] != before:
+            time.sleep(0.25)
+        orphans = client.stats()["num_objects"] - before
+        return (_ipc_bytes(box["table"]), s.engine.shuffle_stage_report(),
+                {"orphans": orphans, "pool": len(s.executors),
+                 "survivors": [h.name for h in s.executors]})
+    finally:
+        raydp_tpu.stop()
+
+
+def test_scale_down_races_lineage_recovery(tmp_path, monkeypatch):
+    """Chaos leg (ISSUE 13a): a graceful scale-down races an in-flight
+    lineage recovery round. A dropped map blob forces recovery while every
+    task is slowed enough that the retirement lands mid-action: the drain
+    takes the retiring executor out of rotation, its in-flight tasks finish
+    or re-queue, and the recovery round re-runs producers on the shrunken
+    pool — byte-identical to a fault-free FIXED-pool run, zero orphaned
+    store objects, recovery surfaced in the ledger."""
+    s = _session3("chaos-scaledown-base")
+    try:
+        df = _frame(s)
+        out = df.groupBy("k").agg(F.sum("v").alias("s"),
+                                  F.count("v").alias("n"))
+        base = _ipc_bytes(s.engine.collect(out._plan)
+                          .sort_by([("k", "ascending")]))
+    finally:
+        raydp_tpu.stop()
+
+    sent = str(tmp_path / "scaledown-drop.sentinel")
+    monkeypatch.setenv(
+        "RDT_FAULTS",
+        "executor.run_task:delay:ms=250;"
+        f"shuffle.write:drop:nth=2:once={sent}")
+    got, report, facts = _collect_groupagg_during_retire("chaos-scaledown")
+    assert os.path.exists(sent), "injected drop never fired"
+    assert got == base
+    assert facts["pool"] == 2, facts
+    assert facts["orphans"] == 0, (
+        f"scale-down racing recovery orphaned {facts['orphans']} objects")
+    assert sum(e.get("recovered", 0) for e in report) >= 1, report
+    assert sum(e.get("regenerated", 0) for e in report) >= 1, report
+
+
+def test_scale_down_drain_crash_races_pipelined_stream(tmp_path,
+                                                      monkeypatch):
+    """Chaos leg (ISSUE 13b): the retiring executor DIES mid-drain
+    (``pool.drain:crash``) while a pipelined shuffle it feeds is
+    mid-stream. Its unfinished map tasks fail and re-run on survivors,
+    their seals publish (or re-seal under the next generation through the
+    PR 7 machinery), streaming reducers keep decoding — byte-identical to
+    a fault-free fixed-pool BARRIER run, zero orphans."""
+    monkeypatch.setenv("RDT_ETL_AQE", "0")
+    monkeypatch.setenv("RDT_SHUFFLE_PIPELINE", "0")
+    s = _session3("chaos-draincrash-base")
+    try:
+        df = _frame(s)
+        out = df.groupBy("k").agg(F.sum("v").alias("s"),
+                                  F.count("v").alias("n"))
+        base = _ipc_bytes(s.engine.collect(out._plan)
+                          .sort_by([("k", "ascending")]))
+    finally:
+        raydp_tpu.stop()
+
+    sent = str(tmp_path / "drain-crash.sentinel")
+    monkeypatch.setenv("RDT_SHUFFLE_PIPELINE", "1")
+    monkeypatch.setenv(
+        "RDT_FAULTS",
+        "executor.run_task:delay:ms=250:match=|mt-;"
+        f"pool.drain:crash:once={sent}")
+    got, report, facts = _collect_groupagg_during_retire(
+        "chaos-draincrash", retire_after_s=0.3)
+    assert os.path.exists(sent), "drain-crash schedule never fired"
+    assert got == base
+    assert any(e["pipelined"] for e in report), report
+    assert facts["pool"] == 2, facts
+    assert facts["orphans"] == 0, (
+        f"drain-crash mid-stream orphaned {facts['orphans']} objects")
+
+
+def test_scale_down_races_live_serving_replica(tmp_path):
+    """Chaos leg (ISSUE 13c): the executor hosting a live serving replica
+    is retired mid-burst. In-flight dispatches re-route through the hedge
+    path, the background reload routes through the pool's LIVE-member view
+    and re-homes the replica onto a survivor (satellite fix — it used to
+    probe the retired corpse until the grace expired) — zero dropped
+    requests, results byte-identical to a fault-free fixed-pool run."""
+    import optax
+
+    from raydp_tpu.models import MLP
+    from raydp_tpu.serve import ServingSession
+    from raydp_tpu.train import FlaxEstimator
+
+    rng = np.random.RandomState(11)
+    x = rng.random_sample((512, 2))
+    y = x @ np.array([2.0, -3.0]) + 1.0
+    pdf = pd.DataFrame({"x1": x[:, 0], "x2": x[:, 1], "y": y})
+    export_dir = str(tmp_path / "scale-servable")
+    results, reports = {}, {}
+
+    for mode in ("clean", "retire"):
+        os.environ["RDT_SERVE_BATCH_TIMEOUT_MS"] = "10"
+        s = raydp_tpu.init(f"serve_scale_{mode}", num_executors=3,
+                           executor_cores=1, executor_memory="512MB")
+        try:
+            if mode == "clean":
+                df = s.createDataFrame(pdf, num_partitions=2)
+                est = FlaxEstimator(
+                    model=MLP(features=(8,), use_batch_norm=False),
+                    optimizer=optax.adam(1e-2), loss="mse",
+                    feature_columns=["x1", "x2"], label_column="y",
+                    batch_size=64, num_epochs=1)
+                est.fit_on_frame(df)
+                est.export_serving(export_dir)
+            srv = ServingSession(export_dir, session=s, name="scalesrv")
+            try:
+                futs = [srv.predict_async({"x1": x[i:i + 2, 0],
+                                           "x2": x[i:i + 2, 1]})
+                        for i in range(0, 64, 2)]
+                if mode == "retire":
+                    # replica scalesrv-r0 lives on executor 0: retire it
+                    # with the burst in flight
+                    s.retire_executor(f"rdt-executor-serve_scale_{mode}-0")
+                burst = [f.result(timeout=120.0) for f in futs]
+                tail = [srv.predict({"x1": x[64 + i:65 + i, 0],
+                                     "x2": x[64 + i:65 + i, 1]},
+                                    timeout=120.0)
+                        for i in range(16)]
+                results[mode] = np.concatenate(burst + tail)
+                # the re-homed replica's background reload may still be
+                # jitting on the survivor: poll until it is back in rotation
+                deadline = time.time() + 60
+                while True:
+                    reports[mode] = srv.serving_report()
+                    if all(r["ready"] for r in reports[mode]["replicas"]) \
+                            or time.time() > deadline:
+                        break
+                    time.sleep(0.25)
+            finally:
+                srv.close()
+        finally:
+            raydp_tpu.stop()
+            os.environ.pop("RDT_SERVE_BATCH_TIMEOUT_MS", None)
+
+    assert reports["retire"]["failed"] == 0, reports["retire"]
+    assert len(results["retire"]) == len(results["clean"]) == 80
+    assert np.array_equal(results["clean"], results["retire"])
+    # the replica re-homed off the retired executor onto a survivor
+    r0 = next(r for r in reports["retire"]["replicas"]
+              if r["replica"] == "scalesrv-r0")
+    assert r0["executor"] != "rdt-executor-serve_scale_retire-0", r0
+    assert r0["ready"], r0
 
 
 def test_serving_replica_crash_reroutes_zero_dropped(tmp_path):

@@ -176,6 +176,85 @@ def test_fit_gang_trains_through_node_agent(session, tmp_path):
             pass
 
 
+class SlowStart:
+    """Actor whose __init__ stalls — the seeded stand-in for the jax/pyarrow
+    import storm a fresh executor pays on a cold node."""
+
+    def __init__(self, delay_s: float = 3.0):
+        time.sleep(delay_s)
+
+    def pid(self) -> int:
+        return os.getpid()
+
+    def put_marker(self, owner: str) -> str:
+        import pyarrow as pa
+
+        from raydp_tpu.runtime.object_store import get_client
+        ref = get_client().put_arrow(pa.table({"a": [1]}), owner=owner)
+        return ref.id
+
+
+def test_spawn_then_reap_roundtrip_under_slow_warmup(runtime):
+    """ISSUE 13 satellite: the scale-up/scale-down round trip through a
+    node agent. Spawn-side: a seeded slow actor __init__ (the import-storm
+    model) is absorbed by the RDT_EXECUTOR_WAIT_S readiness probe — the
+    actor is admitted only once genuinely ready. Reap-side: the kill goes
+    through the head to the agent, the agent's ``reap`` RPC harvests the
+    process-table entry, and neither an orphan process nor the dead owner's
+    store entries survive."""
+    from raydp_tpu import knobs
+    from raydp_tpu.runtime.object_store import ObjectRef
+
+    rt = runtime
+    agent_proc = _start_agent(rt.server.url)
+    try:
+        _wait_nodes(rt, 2)
+        (agent_node,) = list(rt.node_agents)
+
+        warmup = 3.0
+        t0 = time.monotonic()
+        h = rt.create_actor(SlowStart, (warmup,), name="slow-warmup",
+                            node_id=agent_node, resources={"CPU": 1.0},
+                            max_restarts=0, block=False)
+        h.wait_ready(timeout=float(knobs.get("RDT_EXECUTOR_WAIT_S")))
+        assert time.monotonic() - t0 >= warmup, (
+            "readiness reported before the warm-up finished")
+
+        pid = h.pid()
+        listed = rt.node_agents[agent_node].call("list_pids")
+        assert pid in {int(p) for p in listed}
+        oid = h.put_marker("slow-warmup")
+        assert rt.store_client.contains(ObjectRef(id=oid))
+
+        # reap: deliberate kill through the head (the agent kills the
+        # process group), then the agent-side table harvest
+        h.kill(no_restart=True)
+        agent = rt.node_agents[agent_node]
+        code = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            code = agent.call("reap", pid)
+            if code is not None:
+                break
+            time.sleep(0.2)
+        assert code is not None, "reaped process never exited"
+        # no orphan: the process is gone AND its table entry harvested
+        assert not os.path.exists(f"/proc/{pid}")
+        assert pid not in {int(p) for p in agent.call("list_pids")}
+        # the dead owner's store entries are swept by the head
+        deadline = time.time() + 30
+        while time.time() < deadline \
+                and rt.store_client.contains(ObjectRef(id=oid)):
+            time.sleep(0.2)
+        assert not rt.store_client.contains(ObjectRef(id=oid)), (
+            "reap left the dead actor's store entries behind")
+    finally:
+        try:
+            os.killpg(agent_proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
 def test_spmd_ranks_spawn_on_agent_nodes(runtime):
     """A gang with SPREAD placement fans its ranks out across node agents —
     one rank process per machine, mpirun-hosts style."""

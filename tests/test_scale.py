@@ -1,0 +1,485 @@
+"""Elastic executor pool (ISSUE 13): graceful drain, elastic membership,
+restart re-admission, and the autoscale controller.
+
+Units run against stub executor handles (no runtime) and pin the
+driver-side contracts: a draining executor takes no new dispatch, a member
+added mid-stage is used at once, pool-wide busy/queued signals reconcile,
+and ``retire_executor`` runs drain → re-home → remove → reap in order.
+Integration legs run real sessions; the chaos composition (scale-down
+racing recovery / pipelined streams / serving) lives in tests/test_chaos.py.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from raydp_tpu import metrics
+from raydp_tpu.etl.engine import Engine, ExecutorPool
+
+from tests.test_scheduler import StubExecutor, _payloads, _tasks
+
+
+# ==== elastic membership units ================================================
+
+def test_draining_executor_gets_no_new_dispatch(monkeypatch):
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    a = StubExecutor(name="a")
+    b = StubExecutor(name="b")
+    pool = ExecutorPool([a, b])
+    assert pool.begin_drain("a")
+    out = pool.run_tasks(_tasks(4), payloads=_payloads(4))
+    assert all(r is not None for r in out)
+    assert len(a.submits) == 0, "draining executor received new work"
+    assert len(b.submits) == 4
+    # draining is also invisible to locality preference
+    pool.cancel_drain("a")
+    pool.begin_drain("a")
+    pool.run_tasks(_tasks(2), preferred=["a", "a"], payloads=_payloads(2))
+    assert len(a.submits) == 0
+
+
+def test_begin_drain_refuses_last_live_executor():
+    a = StubExecutor(name="a")
+    b = StubExecutor(name="b")
+    pool = ExecutorPool([a, b])
+    assert pool.begin_drain("a")
+    with pytest.raises(ValueError):
+        pool.begin_drain("b")
+    # and double-drain of one executor is a no-op, not an error
+    assert pool.begin_drain("a") is False
+
+
+def test_add_executor_mid_stage_is_dispatched(monkeypatch):
+    """Membership is read per dispatch pass: an executor the autoscaler
+    admits while a stage is running absorbs queued tasks immediately."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    slow = StubExecutor(name="slow", latency=0.15)
+    pool = ExecutorPool([slow])
+    fast = StubExecutor(name="fast", latency=0.005)
+    done = {}
+
+    def run():
+        done["out"] = pool.run_tasks(_tasks(8), max_inflight_per_executor=1,
+                                     payloads=_payloads(8))
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.05)
+    pool.add_executor(fast)
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert all(r is not None for r in done["out"])
+    assert len(fast.submits) >= 3, "mid-stage member was never dispatched"
+
+
+def test_remove_executor_mid_flight_retries_on_survivor(monkeypatch):
+    """An abrupt removal (no drain) leaves in-flight attempts failing; the
+    retry machinery lands them on the surviving member."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    from raydp_tpu.runtime.rpc import ConnectionLost
+
+    a = StubExecutor(name="a")
+    a.script = [(0.05, lambda fut: fut.set_exception(
+        ConnectionLost("killed mid-flight")))] * 2
+    b = StubExecutor(name="b")
+    pool = ExecutorPool([a, b])
+    removed = {}
+
+    def run():
+        removed["out"] = pool.run_tasks(_tasks(4),
+                                        max_inflight_per_executor=2,
+                                        payloads=_payloads(4))
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.02)
+    assert pool.remove_executor("a") is a
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert all(r is not None for r in removed["out"])
+    assert pool.by_name.get("a") is None
+    assert [h.name for h in pool.executors] == ["b"]
+
+
+def test_pool_busy_and_demand_reconcile(monkeypatch):
+    """load() exposes the autoscaler's signals and every exit path of
+    run_tasks reconciles them back to zero."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    slow = StubExecutor(name="slow", latency=0.2)
+    pool = ExecutorPool([slow])
+    seen = {}
+
+    def run():
+        pool.run_tasks(_tasks(6), max_inflight_per_executor=2,
+                       payloads=_payloads(6))
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        load = pool.load()
+        if load["busy"] > 0 and load["queued"] > 0:
+            seen["load"] = load
+            break
+        time.sleep(0.01)
+    t.join(timeout=30)
+    assert seen, "never observed a busy+queued pool mid-stage"
+    assert seen["load"]["busy"] <= 2
+    assert seen["load"]["queued"] >= 1
+    after = pool.load()
+    assert after["busy"] == 0 and after["queued"] == 0, after
+    assert pool.wait_idle("slow", timeout=1.0)
+
+
+def test_mark_up_readmission_symmetry():
+    """A down-marked executor that answers again re-enters placement at
+    once, with the executor_up flight-recorder event mirroring the
+    executor_down it balances (the restarted-mid-action re-admission)."""
+    metrics.reset()
+    a = StubExecutor(name="a")
+    pool = ExecutorPool([a, StubExecutor(name="b")])
+    ident = pool._idents[0]
+    pool._mark_down(ident, "a")
+    assert pool._is_down(ident)
+    pool._mark_up(ident, "a")
+    assert not pool._is_down(ident)
+    pool._mark_up(ident, "a")  # idempotent: no second event
+    kinds = [e["kind"] for e in metrics.events()]
+    assert kinds.count("executor_down") == 1
+    assert kinds.count("executor_up") == 1
+    snap = metrics.snapshot()["counters"]
+    assert snap["sched_executor_up_total"] == {"a": 1}
+
+
+def test_down_executor_readmitted_within_action(monkeypatch):
+    """Satellite: a restarting executor whose submits fail is marked down,
+    but once its address answers again the SAME stage routes work back to
+    it instead of finishing the action on the shrunken remainder."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    metrics.reset()
+    a = StubExecutor(name="a")
+    a.script = ["connlost"]  # first submit refused (restart in flight)
+    b = StubExecutor(name="b", latency=0.3)
+    pool = ExecutorPool([a, b])
+    # drop the down TTL so the restarted executor is probed inside this
+    # stage rather than 10s later
+    monkeypatch.setattr("raydp_tpu.etl.engine._DOWN_TTL_S", 0.2)
+    out = pool.run_tasks(_tasks(6), max_inflight_per_executor=1,
+                         payloads=_payloads(6))
+    assert all(r is not None for r in out)
+    assert len(a.submits) >= 1, "restarted executor was never re-admitted"
+    kinds = [e["kind"] for e in metrics.events()]
+    assert "executor_down" in kinds and "executor_up" in kinds
+
+
+# ==== retire_executor (drain protocol) units =================================
+
+def _engine(pool):
+    return Engine(pool, shuffle_partitions=4)
+
+
+def test_retire_executor_drain_rehome_reap_order(monkeypatch):
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    a = StubExecutor(name="a")
+    b = StubExecutor(name="b")
+    pool = ExecutorPool([a, b])
+    eng = _engine(pool)
+    calls = []
+    out = eng.retire_executor(
+        "a",
+        rehome=lambda name: calls.append(("rehome", name)) or 7,
+        reap=lambda h: calls.append(("reap", h.name)))
+    assert calls == [("rehome", "a"), ("reap", "a")]
+    assert out == {"executor": "a", "quiesced": True, "rehomed": 7,
+                   "pool_size": 1}
+    assert [h.name for h in pool.executors] == ["b"]
+    with pytest.raises(KeyError):
+        eng.retire_executor("a")
+
+
+def test_retire_executor_rehome_knob_off(monkeypatch):
+    monkeypatch.setenv("RDT_DRAIN_REHOME", "0")
+    pool = ExecutorPool([StubExecutor(name="a"), StubExecutor(name="b")])
+    eng = _engine(pool)
+    calls = []
+    out = eng.retire_executor("a", rehome=lambda n: calls.append(n) or 3)
+    assert calls == [], "RDT_DRAIN_REHOME=0 still re-homed"
+    assert out["rehomed"] == 0
+
+
+def test_retire_executor_waits_for_inflight(monkeypatch):
+    """The drain quiesce point: retire blocks until the victim's in-flight
+    task completes (pool-wide busy hits zero), and the task's result is
+    kept — drained, never dropped."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    slow = StubExecutor(name="slow", latency=0.4)
+    fast = StubExecutor(name="fast")
+    pool = ExecutorPool([slow, fast])
+    eng = _engine(pool)
+    done = {}
+
+    def run():
+        done["out"] = pool.run_tasks(_tasks(1), preferred=["slow"],
+                                     payloads=_payloads(1))
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.1)  # the task is in flight on `slow`
+    t0 = time.monotonic()
+    out = eng.retire_executor("slow")
+    assert out["quiesced"] is True
+    assert time.monotonic() - t0 >= 0.2, "drain did not wait for in-flight"
+    t.join(timeout=10)
+    assert done["out"][0] is not None
+
+
+def test_retire_executor_failed_rehome_abandons(monkeypatch):
+    """A re-home failure degrades to abandonment (lineage rebuilds on
+    read), never fails the retirement."""
+    pool = ExecutorPool([StubExecutor(name="a"), StubExecutor(name="b")])
+    eng = _engine(pool)
+
+    def boom(name):
+        raise RuntimeError("re-home exploded")
+
+    out = eng.retire_executor("a", rehome=boom)
+    assert out["rehomed"] == 0
+    assert [h.name for h in pool.executors] == ["b"]
+
+
+def test_retire_last_executor_refused():
+    pool = ExecutorPool([StubExecutor(name="only")])
+    eng = _engine(pool)
+    with pytest.raises(ValueError):
+        eng.retire_executor("only")
+    # the refusal leaves it dispatchable
+    assert pool.run_tasks(_tasks(1), payloads=_payloads(1))[0] is not None
+
+
+def test_retire_records_drain_event_and_counters():
+    metrics.reset()
+    pool = ExecutorPool([StubExecutor(name="a"), StubExecutor(name="b")])
+    _engine(pool).retire_executor("a")
+    kinds = [e["kind"] for e in metrics.events()]
+    assert "executor_drain" in kinds
+    snap = metrics.snapshot()
+    assert snap["counters"]["pool_drains_total"] == {"": 1}
+    assert snap["gauges"]["pool_size"] == {"": 1}
+
+
+# ==== autoscale controller units =============================================
+
+class _FakeSession:
+    """Session stand-in the controller drives: grow/shrink calls recorded,
+    a real ExecutorPool supplies load()."""
+
+    def __init__(self, pool):
+        self.engine = SimpleNamespace(pool=pool)
+        self.grown = 0
+        self.retired = []
+
+    def _grow_executor(self):
+        h = StubExecutor(name=f"new-{self.grown}")
+        self.grown += 1
+        self.engine.pool.add_executor(h)
+        return h
+
+    def _shrink_candidate(self):
+        names = [h.name for h in self.engine.pool.executors]
+        return names[-1] if len(names) > 1 else None
+
+    def retire_executor(self, name):
+        self.retired.append(name)
+        self.engine.pool.remove_executor(name)
+
+
+def _autoscaler(sess, **kw):
+    from raydp_tpu.etl.autoscale import PoolAutoscaler
+    return PoolAutoscaler(sess, **kw)
+
+
+def test_autoscaler_grows_on_sustained_queue(monkeypatch):
+    monkeypatch.setenv("RDT_POOL_SCALE_UP_S", "0")
+    monkeypatch.setenv("RDT_POOL_COOLDOWN_S", "0")
+    pool = ExecutorPool([StubExecutor(name="e0")])
+    sess = _FakeSession(pool)
+    auto = _autoscaler(sess, min_size=1, max_size=3)
+    pool._demand_delta(5)  # queued demand, nothing in flight
+    auto._tick()  # window (0s) satisfied at once: grow
+    assert sess.grown == 1
+    assert [e["direction"] for e in auto.events] == ["up"]
+    assert len(pool.executors) == 2
+    pool._demand_delta(-5)
+
+
+def test_autoscaler_spike_does_not_thrash(monkeypatch):
+    """Hysteresis: a queue spike shorter than RDT_POOL_SCALE_UP_S never
+    grows, and after a scale event the cooldown blocks the next decision."""
+    monkeypatch.setenv("RDT_POOL_SCALE_UP_S", "30")
+    pool = ExecutorPool([StubExecutor(name="e0")])
+    sess = _FakeSession(pool)
+    auto = _autoscaler(sess, min_size=1, max_size=3)
+    pool._demand_delta(5)
+    auto._tick()
+    auto._tick()
+    assert sess.grown == 0, "a short spike grew the pool"
+    pool._demand_delta(-5)
+    # cooldown: force an event, then make the pool look grow-worthy
+    monkeypatch.setenv("RDT_POOL_SCALE_UP_S", "0")
+    monkeypatch.setenv("RDT_POOL_COOLDOWN_S", "60")
+    auto._note("up", 1, "test")
+    pool._demand_delta(5)
+    auto._tick()
+    auto._tick()
+    assert sess.grown == 0, "cooldown was ignored"
+    pool._demand_delta(-5)
+
+
+def test_autoscaler_shrinks_idle_pool_to_min(monkeypatch):
+    monkeypatch.setenv("RDT_POOL_IDLE_S", "0")
+    monkeypatch.setenv("RDT_POOL_COOLDOWN_S", "0")
+    pool = ExecutorPool([StubExecutor(name="e0"), StubExecutor(name="e1"),
+                         StubExecutor(name="e2")])
+    sess = _FakeSession(pool)
+    auto = _autoscaler(sess, min_size=1, max_size=3)
+    for _ in range(6):
+        auto._tick()
+    assert sess.retired == ["e2", "e1"]
+    assert len(pool.executors) == 1, "shrank past min or not at all"
+
+
+def test_autoscaler_respects_max(monkeypatch):
+    monkeypatch.setenv("RDT_POOL_SCALE_UP_S", "0")
+    monkeypatch.setenv("RDT_POOL_COOLDOWN_S", "0")
+    pool = ExecutorPool([StubExecutor(name="e0")])
+    sess = _FakeSession(pool)
+    auto = _autoscaler(sess, min_size=1, max_size=2)
+    pool._demand_delta(50)
+    for _ in range(6):
+        auto._tick()
+    assert len(pool.executors) == 2, "grew past max"
+    pool._demand_delta(-50)
+
+
+def test_autoscaler_requires_sane_bounds():
+    pool = ExecutorPool([StubExecutor(name="e0")])
+    with pytest.raises(ValueError):
+        _autoscaler(_FakeSession(pool))  # RDT_POOL_MAX default 0 < min
+
+
+# ==== live integration =======================================================
+
+def _ipc_bytes(table):
+    import pyarrow as pa
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
+def test_session_retire_executor_live():
+    """End-to-end drain on a real 3-executor session: persisted blocks
+    re-home onto survivors, results stay byte-identical, the store ends at
+    its pre-drain object count, and the process is reaped."""
+    import raydp_tpu
+    from raydp_tpu.etl import functions as F
+    from raydp_tpu.runtime.object_store import get_client
+
+    s = raydp_tpu.init("scale-retire", num_executors=3, executor_cores=1,
+                       executor_memory="512MB")
+    try:
+        rng = np.random.RandomState(0)
+        pdf = pd.DataFrame({"k": rng.randint(0, 50, 4000),
+                            "v": rng.randint(0, 1000, 4000).astype(np.int64)})
+        df = s.createDataFrame(pdf, num_partitions=4)
+        out = df.groupBy("k").agg(F.sum("v").alias("s"))
+        base = _ipc_bytes(s.engine.collect(out._plan)
+                          .sort_by([("k", "ascending")]))
+        cached = df.persist()
+        assert cached.count() == 4000
+        before = get_client().stats()["num_objects"]
+
+        victim = s.executors[-1].name
+        # the drain inventory: what the retiring executor uniquely holds
+        info = s.executors[-1].call("drain_info")
+        assert info["executor"] == victim
+        frame = list(s._cached_frames.values())[0]
+        victims_blocks = {k for k, owner in zip(frame.cache_keys,
+                                                frame.executors)
+                          if owner == victim}
+        assert victims_blocks <= set(info["blocks"])
+
+        size = s.retire_executor(victim)
+        assert size == 2 and len(s.executors) == 2
+        assert victim not in {h.name for h in s.executors}
+        # no cached partition still claims the retiree (all re-homed)
+        frame_id = list(s._cached_frames)[0]
+        assert victim not in s._cached_frames[frame_id].executors
+        # the re-homed blocks really live on the survivors
+        for h in s.executors:
+            for key, owner in zip(s._cached_frames[frame_id].cache_keys,
+                                  s._cached_frames[frame_id].executors):
+                if owner == h.name:
+                    assert h.call("has_block", key)
+
+        got = _ipc_bytes(s.engine.collect(out._plan)
+                         .sort_by([("k", "ascending")]))
+        assert got == base
+        assert cached.count() == 4000
+        assert get_client().stats()["num_objects"] == before, \
+            "drain leaked store objects"
+    finally:
+        raydp_tpu.stop()
+
+
+def test_session_autoscale_grow_and_shrink_live(monkeypatch):
+    """The recorded-bench shape at test scale: a queued burst grows the
+    pool within RDT_POOL_MAX, the idle window drains it back to min, and
+    every action succeeds with identical results."""
+    import raydp_tpu
+    from raydp_tpu.etl import functions as F
+
+    monkeypatch.setenv("RDT_POOL_SCALE_INTERVAL_S", "0.2")
+    monkeypatch.setenv("RDT_POOL_SCALE_UP_S", "0.4")
+    monkeypatch.setenv("RDT_POOL_IDLE_S", "1.5")
+    monkeypatch.setenv("RDT_POOL_COOLDOWN_S", "1.0")
+    monkeypatch.setenv("RDT_FAULTS", "executor.run_task:delay:ms=400")
+    s = raydp_tpu.init("scale-auto", num_executors=1, executor_cores=1,
+                       executor_memory="512MB")
+    try:
+        auto = s.autoscale(min_size=1, max_size=3)
+        rng = np.random.RandomState(0)
+        pdf = pd.DataFrame({"k": rng.randint(0, 50, 8000),
+                            "v": rng.randint(0, 1000, 8000).astype(np.int64)})
+        df = s.createDataFrame(pdf, num_partitions=8)
+        out = df.groupBy("k").agg(F.sum("v").alias("s"))
+        results, errs = [], []
+
+        def run():
+            try:
+                results.append(_ipc_bytes(
+                    s.engine.collect(out._plan)
+                    .sort_by([("k", "ascending")])))
+            except Exception as e:  # noqa: BLE001 - assert below
+                errs.append(e)
+
+        threads = [threading.Thread(target=run) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs, errs
+        assert any(e["direction"] == "up" for e in auto.events), \
+            "queued burst never grew the pool"
+        deadline = time.time() + 30
+        while time.time() < deadline and len(s.executors) > 1:
+            time.sleep(0.3)
+        assert len(s.executors) == 1, "idle pool never drained back to min"
+        assert any(e["direction"] == "down" for e in auto.events)
+        assert len(set(results)) == 1, "burst results diverged"
+    finally:
+        raydp_tpu.stop()

@@ -320,6 +320,67 @@ def test_app_error_fails_fast_without_reroute(monkeypatch):
         srv.close()
 
 
+def test_reload_rebinds_replica_off_retired_executor(monkeypatch):
+    """Satellite (ISSUE 13): the background reload used to probe a FIXED
+    executor identity until the re-route grace expired. With the owning
+    session's live-member view available, a replica whose executor was
+    retired from the pool re-homes onto a surviving member and reloads
+    there — requests keep flowing the whole time."""
+    from types import SimpleNamespace
+
+    class RetireableHandle(FakeReplicaHandle):
+        def __init__(self, name):
+            super().__init__(name)
+            self.dead = False
+
+        def call(self, method, *args, timeout=None, **kwargs):
+            if self.dead:
+                raise ConnectionLost(f"{self.name} was retired")
+            return super().call(method, *args, timeout=timeout, **kwargs)
+
+        def submit(self, method, *args, **kwargs):
+            if self.dead:
+                raise ConnectionLost(f"{self.name} was retired")
+            return super().submit(method, *args, **kwargs)
+
+    r0 = RetireableHandle("ex0")
+    r1 = FakeReplicaHandle("ex1")
+    r2 = FakeReplicaHandle("ex2")
+    monkeypatch.setenv("RDT_SERVE_MAX_BATCH", "1000")
+    monkeypatch.setenv("RDT_SERVE_BATCH_TIMEOUT_MS", "5")
+    monkeypatch.setenv("RDT_SERVE_HEDGE", "0")
+    monkeypatch.setenv("RDT_SERVE_REROUTE_GRACE_S", "20")
+    # the session's live-member view: ex0 already retired, ex2 a survivor
+    # that never hosted a replica
+    fake_session = SimpleNamespace(executors=[r1, r2])
+    srv = ServingSession("/nonexistent/bundle", session=fake_session,
+                         executors=[r0, r1], name="t")
+    try:
+        r0.dead = True  # the retirement lands after construction
+        # first dispatch routes to t-r0 (round-robin start), fails, and
+        # re-routes; the reload must re-home t-r0 onto ex2 (least loaded
+        # live member), not keep dialing the corpse
+        out = srv.predict(_rows(1.0, 2.0), timeout=30.0)
+        np.testing.assert_allclose(out, [2.0, 4.0])
+        deadline = time.time() + 20
+        rep0 = None
+        while time.time() < deadline:
+            rep0 = next(r for r in srv.serving_report()["replicas"]
+                        if r["replica"] == "t-r0")
+            if rep0["ready"] and rep0["executor"] == "ex2":
+                break
+            time.sleep(0.1)
+        assert rep0 and rep0["executor"] == "ex2", rep0
+        assert rep0["ready"], rep0
+        assert r2.loads >= 1, "survivor never loaded the re-homed replica"
+        # and the re-homed replica serves again
+        out2 = srv.predict(_rows(3.0), timeout=30.0)
+        np.testing.assert_allclose(out2, [6.0])
+        assert srv.serving_report()["failed"] == 0
+    finally:
+        srv.close()
+
+
 def test_mixed_schemas_coalesce_separately(monkeypatch):
     """Requests with different schemas in one batching window dispatch as
     separate batches — a mixed concat would fail and punish well-formed
