@@ -1,7 +1,7 @@
 """Elastic-pool bench: autoscale under a queued burst + chaos-hardened
-scale-down (ISSUE 13 acceptance).
+scale-down (ISSUE 13 acceptance), plus multi-tenant fairness (ISSUE 14).
 
-Two configs, each a fresh session:
+Three configs, each a fresh session:
 
 1. ``autoscale`` — a 1-executor session with the controller armed
    (min=1, max=3, fast cadence) under a seeded per-task delay
@@ -20,11 +20,25 @@ Two configs, each a fresh session:
    the end must carry the drain/recovery evidence chain
    (``executor_drain`` → ``executor_down`` → ``recovery_round``).
 
+3. ``fairness`` (``--fairness``; the ``chaos-overload`` CI leg) — the
+   multi-tenant overload contract on one fixed 2-executor pool under a
+   seeded per-map delay: a FLOODING tenant (a second ``Engine`` over the
+   session's pool, tenant="flood") loops wide groupaggs while the
+   INTERACTIVE tenant runs a stream of small groupaggs — every
+   interactive action must return bytes identical to its uncontended
+   baseline with its p99 bounded (never queued behind the flood), zero
+   failed accepted actions on either tenant, and a zero-orphan store
+   audit; then two SATURATING tenants at weights 3:1 must show a
+   per-tenant dispatch split within tolerance of the weight ratio.
+   Recorded in ``benchmarks/FAIR.json``.
+
 ``--smoke`` shrinks the load, writes to /tmp (never the recorded
 artifact), and ASSERTS the CI contract above; the full run records
-``benchmarks/SCALE.json`` (override with ``--out``).
+``benchmarks/SCALE.json`` — or ``benchmarks/FAIR.json`` with
+``--fairness`` (override with ``--out``).
 
-Run: RDT_FAULTS_SEED=7 python benchmarks/scale_bench.py [--smoke] [--out P]
+Run: RDT_FAULTS_SEED=7 python benchmarks/scale_bench.py [--fairness]
+     [--smoke] [--out P]
 """
 
 import argparse
@@ -226,15 +240,194 @@ def run_chaos_scale_config(smoke):
     return record
 
 
+def run_fairness_config(smoke):
+    """Config 3: flood + interactive tenants on one pool, then a weighted
+    3:1 saturation split (the ISSUE 14 fairness contract)."""
+    import raydp_tpu
+    from raydp_tpu.etl.engine import Engine
+
+    rows_wide = 12_000 if smoke else 40_000
+    parts_wide = 24 if smoke else 48
+    inter_actions = 6 if smoke else 16
+    # per-MAP delay (both tenants alike): stretches every map stage so the
+    # flood holds a real backlog without inflating data volume
+    os.environ["RDT_FAULTS"] = "executor.run_task:delay:ms=120:match=|mt-"
+    s = raydp_tpu.init("fair-bench", num_executors=2, executor_cores=1,
+                       executor_memory="512MB")
+    try:
+        from raydp_tpu.runtime.object_store import get_client
+        client = get_client()
+        pool = s.engine.pool
+        small = _frame(s, 4_000 if smoke else 8_000, 4)
+        rng = np.random.RandomState(1)
+        wide = s.createDataFrame(pd.DataFrame({
+            "k": rng.randint(0, 50, rows_wide),
+            "v": rng.randint(0, 1000, rows_wide).astype(np.int64),
+        }), num_partitions=parts_wide)
+        before = client.stats()["num_objects"]
+
+        # uncontended interactive baseline (bytes + wall)
+        t0 = time.time()
+        base_small = _groupagg_bytes(s, small)
+        uncontended_s = time.time() - t0
+
+        flood_eng = Engine(pool,
+                           shuffle_partitions=s.engine.shuffle_partitions,
+                           owner=s.engine.owner, tenant="flood")
+        from raydp_tpu.etl import functions as F
+        out_w = wide.groupBy("k").agg(F.sum("v").alias("s"),
+                                      F.count("v").alias("n"))
+        stop = threading.Event()
+        flood_stats = {"actions": 0, "errors": []}
+
+        def flood():
+            while not stop.is_set():
+                try:
+                    _ipc_bytes(flood_eng.collect(out_w._plan)
+                               .sort_by([("k", "ascending")]))
+                    flood_stats["actions"] += 1
+                except Exception as e:  # noqa: BLE001 - counted below
+                    flood_stats["errors"].append(repr(e))
+                    return
+
+        tf = threading.Thread(target=flood)
+        tf.start()
+        deadline = time.time() + 60
+        while time.time() < deadline and (pool.load()["tenants"]
+                                          .get("flood", {})
+                                          .get("queued", 0)) < 4:
+            time.sleep(0.02)
+
+        # the interactive stream under the flood
+        walls, mismatches = [], 0
+        flood_queued_seen = 0
+        for _ in range(inter_actions):
+            flood_queued_seen = max(
+                flood_queued_seen,
+                pool.load()["tenants"].get("flood", {}).get("queued", 0))
+            t0 = time.time()
+            got = _groupagg_bytes(s, small)
+            walls.append(time.time() - t0)
+            if got != base_small:
+                mismatches += 1
+        stop.set()
+        tf.join(timeout=600)
+        walls.sort()
+        p50 = walls[len(walls) // 2]
+        p99 = walls[min(len(walls) - 1, int(0.99 * len(walls)))]
+
+        # weighted phase: two SATURATING tenants at 3:1, sampled when the
+        # heavy one finishes (both still contending throughout its run)
+        eng_a = Engine(pool, shuffle_partitions=s.engine.shuffle_partitions,
+                       owner=s.engine.owner, tenant="wA", tenant_weight=1.0)
+        eng_b = Engine(pool, shuffle_partitions=s.engine.shuffle_partitions,
+                       owner=s.engine.owner, tenant="wB", tenant_weight=3.0)
+        boxes = {}
+
+        def run_w(tag, eng):
+            try:
+                boxes[tag] = _ipc_bytes(eng.collect(out_w._plan)
+                                        .sort_by([("k", "ascending")]))
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                boxes[tag + "_error"] = repr(e)
+
+        ta = threading.Thread(target=run_w, args=("wA", eng_a))
+        tb = threading.Thread(target=run_w, args=("wB", eng_b))
+        ta.start()
+        tb.start()
+        # the split only means something WHILE both tenants contend (once
+        # the heavy action's queue drains, the light one rightly floods the
+        # freed slots): keep the last sample with both queues nonempty.
+        # Note the per-stage in-flight caps bound the achievable ratio —
+        # the heavy tenant can hold at most one stage's cap worth of slots
+        # — so "tracks the weights" is a tolerance band, not an equality.
+        sample = None
+        deadline = time.time() + 600
+        while tb.is_alive() and time.time() < deadline:
+            t = pool.load()["tenants"]
+            a, b = t.get("wA", {}), t.get("wB", {})
+            if a.get("queued", 0) > 0 and b.get("queued", 0) > 0 \
+                    and a.get("dispatched", 0) >= 4:
+                sample = (a["dispatched"], b["dispatched"])
+            time.sleep(0.05)
+        tb.join(timeout=600)
+        ta.join(timeout=600)
+        disp_a, disp_b = sample if sample else (0, 0)
+        ratio = (disp_b / disp_a) if disp_a else float("inf")
+
+        deadline = time.time() + 30
+        while time.time() < deadline \
+                and client.stats()["num_objects"] != before:
+            time.sleep(0.25)
+        record = {
+            "interactive_actions": inter_actions,
+            "interactive_failed": mismatches,
+            "results_identical": mismatches == 0,
+            "uncontended_s": round(uncontended_s, 3),
+            "contended_p50_s": round(p50, 3),
+            "contended_p99_s": round(p99, 3),
+            "p99_bounded": p99 < 10.0 * max(uncontended_s, 0.5) + 2.0,
+            "flood_actions": flood_stats["actions"],
+            "flood_failed": len(flood_stats["errors"]),
+            "flood_errors": flood_stats["errors"],
+            "flood_queued_seen": flood_queued_seen,
+            "weight_ratio": 3.0,
+            "observed_dispatch_ratio": round(ratio, 2),
+            "ratio_within_tolerance": 1.5 <= ratio <= 6.0,
+            "weighted_identical": boxes.get("wA") == boxes.get("wB"),
+            "weighted_errors": [boxes[k] for k in boxes if "error" in k],
+            "orphans": client.stats()["num_objects"] - before,
+        }
+    finally:
+        raydp_tpu.stop()
+        os.environ.pop("RDT_FAULTS", None)
+    print(f"[fairness] p99={record['contended_p99_s']}s "
+          f"(uncontended {record['uncontended_s']}s) "
+          f"ratio={record['observed_dispatch_ratio']} "
+          f"failed={record['interactive_failed']} "
+          f"orphans={record['orphans']}")
+    return record
+
+
+def _assert_fairness(fair):
+    assert fair["interactive_failed"] == 0, fair
+    assert fair["results_identical"], fair
+    assert fair["flood_failed"] == 0, fair
+    assert fair["flood_queued_seen"] > 0, fair  # the flood really contended
+    assert fair["p99_bounded"], fair
+    assert fair["ratio_within_tolerance"], fair
+    assert fair["weighted_identical"], fair
+    assert not fair["weighted_errors"], fair
+    assert fair["orphans"] == 0, fair
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("--smoke", action="store_true",
                     help="CI contract: small load, asserts, writes to /tmp")
+    ap.add_argument("--fairness", action="store_true",
+                    help="run ONLY the multi-tenant fairness config "
+                         "(records benchmarks/FAIR.json)")
     ap.add_argument("--out", default=None, help="record path override")
     args = ap.parse_args()
+    here = os.path.dirname(os.path.abspath(__file__))
+    if args.fairness:
+        out = args.out or ("/tmp/FAIR_SMOKE.json" if args.smoke
+                           else os.path.join(here, "FAIR.json"))
+        record = {
+            "bench": "scale_bench",
+            "smoke": args.smoke,
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "configs": {"fairness": run_fairness_config(args.smoke)},
+        }
+        with open(out, "w") as fh:
+            json.dump(record, fh, indent=2, sort_keys=True)
+        print(f"record written to {out}")
+        _assert_fairness(record["configs"]["fairness"])
+        print("fairness bench contract: OK")
+        return
     out = args.out or ("/tmp/SCALE_SMOKE.json" if args.smoke else
-                       os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                    "SCALE.json"))
+                       os.path.join(here, "SCALE.json"))
     record = {
         "bench": "scale_bench",
         "smoke": args.smoke,

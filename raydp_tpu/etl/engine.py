@@ -45,6 +45,14 @@ class StageError(RuntimeError):
     pass
 
 
+class AdmissionRejected(StageError):
+    """An action parked at admission control (its queued demand would push
+    the pool's backlog past ``RDT_POOL_MAX_QUEUED``) and the backlog never
+    drained within ``RDT_ADMIT_TIMEOUT_S``. Typed and NO-RETRY by contract:
+    re-submitting the same action against the same overloaded pool replays
+    the rejection — callers should shed load or raise the bound."""
+
+
 class ObjectsLostError(StageError):
     """A stage task read intermediates whose store blobs are gone (host died,
     payload dropped). Retrying the consumer replays the miss, so the pool
@@ -398,7 +406,15 @@ _NO_RETRY_EXC_TYPES = {
     "KeyError", "ValueError", "TypeError", "AttributeError", "IndexError",
     "ZeroDivisionError", "ArrowInvalid", "ArrowNotImplementedError",
     "ArrowKeyError", "ArrowTypeError", "ShuffleStreamAborted",
+    "AdmissionRejected",
 }
+
+#: how often the dispatch path re-evaluates store memory pressure (the
+#: watermark check reads one stats() snapshot per interval, never per task)
+_BACKPRESSURE_POLL_S = 0.5
+
+#: the fallback tenant id of an untagged run_tasks call
+_DEFAULT_TENANT = "default"
 
 
 class ExecutorPool:
@@ -461,6 +477,33 @@ class ExecutorPool:
         #: outstanding tasks across all active run_tasks calls (queued +
         #: in-flight); demand - busy = the autoscaler's queue-depth signal
         self._demand = 0  # guarded-by: _lock
+        # ---- multi-tenant fair sharing + admission (doc/etl.md "Fair
+        # sharing and admission"): per-tenant twins of _busy/_demand, the
+        # registered weights, and cumulative dispatch counts. busy/demand/
+        # weight entries drop when a tenant goes fully idle; dispatched is
+        # cumulative (bounded by the number of tenants ever seen).
+        self._tenant_busy: Dict[str, int] = {}  # guarded-by: _lock
+        self._tenant_demand: Dict[str, int] = {}  # guarded-by: _lock
+        self._tenant_weight: Dict[str, float] = {}  # guarded-by: _lock
+        self._tenant_dispatched: Dict[str, int] = {}  # guarded-by: _lock
+        #: per-tenant demand registered by actions still PARKED at admission
+        #: — included in _demand (the autoscaler must see it and grow to
+        #: absorb it) but excluded from the admission backlog (two parked
+        #: actions must not hold each other out past an already-drained
+        #: queue) AND from the fair-share contention scan (a parked tenant
+        #: cannot take the slot the gate would reserve for it — counting it
+        #: would serialize every running tenant for the whole park)
+        self._parked_by_tenant: Dict[str, int] = {}  # guarded-by: _lock
+        # ---- memory backpressure: hosts paused above the store
+        # high-watermark (hysteresis: released below the low-watermark).
+        # The cache tuple (expiry, frozenset) is swapped atomically and
+        # read lock-free on the dispatch hot path.
+        self._pressure_lock = threading.Lock()
+        self._bp_active: set = set()  # guarded-by: _pressure_lock
+        self._pressure_cache: Optional[Tuple[float, frozenset]] = None
+        #: test/override hook: a callable returning {host_id: fraction of
+        #: its store budget in shm}; None = read the store's stats()
+        self.pressure_provider = None
 
     @staticmethod
     def _executor_ident(h) -> str:
@@ -600,6 +643,16 @@ class ExecutorPool:
             down = {i for i, t in self._down.items()
                     if now - t < _DOWN_TTL_S}
             demand = self._demand
+            tenants = {
+                t: {"busy": self._tenant_busy.get(t, 0),
+                    "demand": self._tenant_demand.get(t, 0),
+                    "queued": max(0, self._tenant_demand.get(t, 0)
+                                  - self._tenant_busy.get(t, 0)),
+                    "weight": self._tenant_weight.get(t, 1.0),
+                    "dispatched": self._tenant_dispatched.get(t, 0)}
+                for t in set(self._tenant_demand) | set(self._tenant_busy)
+                | set(self._tenant_dispatched)}
+            parked = sum(self._parked_by_tenant.values())
         live = [i for _, i in members if i not in draining]
         busy_total = sum(busy.get(i, 0) for i in live)
         return {
@@ -609,8 +662,11 @@ class ExecutorPool:
             "draining": len(draining),
             "busy": busy_total,
             "queued": max(0, demand - sum(busy.values())),
+            "parked": parked,
+            "backpressured_hosts": sorted(self._pressured_hosts()),
             "per_executor_busy": {
                 (h.name or i): busy.get(i, 0) for h, i in members},
+            "tenants": tenants,
         }
 
     def draining_names(self) -> List[str]:
@@ -621,15 +677,23 @@ class ExecutorPool:
 
     def _dispatch_view(self) -> Tuple[List[Tuple[ActorHandle, str]], set]:
         """One-lock snapshot for a dispatch pass: dispatchable (handle,
-        ident) pairs (draining members excluded) plus the set of
-        currently-down idents — the scheduling hot loops evaluate
-        membership/downness against this copy instead of taking the pool
-        lock once per member per pass."""
+        ident) pairs (draining members excluded, members on a
+        memory-backpressured host excluded) plus the set of currently-down
+        idents — the scheduling hot loops evaluate membership/downness
+        against this copy instead of taking the pool lock once per member
+        per pass. With EVERY host paused dispatch simply waits (graceful
+        degradation: the queue holds, the autoscaler still sees demand, and
+        the store drains below the low watermark instead of OOMing)."""
         now = time.monotonic()
+        pressured = self._pressured_hosts()
         with self._lock:
             draining = self._draining
+            hosts = self.hosts_by_name
             members = [(h, i) for h, i in zip(self.executors, self._idents)
-                       if i not in draining]
+                       if i not in draining
+                       and (not pressured
+                            or hosts.get(h.name or "", HEAD_HOST)
+                            not in pressured)]
             down = {i for i, t in self._down.items()
                     if now - t < _DOWN_TTL_S}
         return members, down
@@ -668,17 +732,199 @@ class ExecutorPool:
             logger.info("executor %s is reachable again; re-admitted to "
                         "task placement", name)
 
-    def _busy_delta(self, ident: str, n: int) -> None:
-        with self._lock:
-            cur = self._busy.get(ident, 0) + n
-            if cur > 0:
-                self._busy[ident] = cur
-            else:
-                self._busy.pop(ident, None)
+    @staticmethod
+    def _bump(counts: Dict[str, int], key: str, n: int) -> None:
+        """Adjust one floor-at-zero counter map entry, dropping it at 0.
+        Caller holds ``_lock``."""
+        cur = counts.get(key, 0) + n
+        if cur > 0:
+            counts[key] = cur
+        else:
+            counts.pop(key, None)
 
-    def _demand_delta(self, n: int) -> None:
+    def _maybe_drop_tenant(self, tenant: str) -> None:  # guarded-by: _lock
+        """Forget a tenant's weight once it carries no busy and no demand
+        (its next action re-registers). Caller holds ``_lock``."""
+        if not self._tenant_busy.get(tenant) \
+                and not self._tenant_demand.get(tenant):
+            self._tenant_weight.pop(tenant, None)
+
+    def _busy_delta(self, ident: str, n: int,
+                    tenant: Optional[str] = None) -> None:
+        with self._lock:
+            self._bump(self._busy, ident, n)
+            if tenant is not None:
+                self._bump(self._tenant_busy, tenant, n)
+                self._maybe_drop_tenant(tenant)
+
+    def _demand_delta(self, n: int, tenant: Optional[str] = None) -> None:
         with self._lock:
             self._demand = max(0, self._demand + n)
+            if tenant is not None:
+                self._bump(self._tenant_demand, tenant, n)
+                self._maybe_drop_tenant(tenant)
+
+    def _register_tenant(self, tenant: str, weight: float) -> None:
+        with self._lock:
+            self._tenant_weight[tenant] = weight
+
+    def _note_dispatch(self, tenant: str) -> None:
+        with self._lock:
+            self._tenant_dispatched[tenant] = \
+                self._tenant_dispatched.get(tenant, 0) + 1
+
+    def _fair_ok(self, tenant: str) -> bool:
+        """Deficit-weighted fair-share gate: may ``tenant`` take the next
+        executor slot? Always yes without contention (no OTHER tenant has
+        queued work). Under contention a tenant may dispatch only while its
+        in-flight count stays within one task of ``weight × the minimum
+        busy/weight share`` among the contending tenants — so the
+        least-served (deficit) tenant always passes, per-tenant in-flight
+        shares converge to the weight ratio, and an idle tenant's first
+        task never waits behind a thousand queued batch tasks."""
+        with self._lock:
+            min_share = None
+            for t, d in self._tenant_demand.items():
+                if t == tenant:
+                    continue
+                b = self._tenant_busy.get(t, 0)
+                if d - self._parked_by_tenant.get(t, 0) - b <= 0:
+                    # nothing DISPATCHABLE queued: no claim on the next
+                    # slot (admission-parked demand is excluded — a parked
+                    # tenant cannot take the slot this gate would hold)
+                    continue
+                share = b / self._tenant_weight.get(t, 1.0)
+                if min_share is None or share < min_share:
+                    min_share = share
+            if min_share is None:
+                return True
+            busy = self._tenant_busy.get(tenant, 0)
+            return busy < self._tenant_weight.get(tenant, 1.0) \
+                * min_share + 1
+
+    def _admit(self, tenant: str, n: int) -> None:
+        """Admission control (``RDT_POOL_MAX_QUEUED``): park this call while
+        the pool's ADMITTED queued backlog plus its ``n`` tasks would exceed
+        the bound. The caller has already registered its demand, so the
+        autoscaler sees the parked work and can grow to absorb it (busy
+        capacity up → backlog down → admitted). An empty backlog always
+        admits — a single action larger than the bound must run, not wedge.
+        Past ``RDT_ADMIT_TIMEOUT_S`` the call fails with the typed no-retry
+        :class:`AdmissionRejected`."""
+        max_q = int(knobs.get("RDT_POOL_MAX_QUEUED"))
+        if max_q <= 0 or n <= 0:
+            return
+        timeout = float(knobs.get("RDT_ADMIT_TIMEOUT_S"))
+        deadline = time.monotonic() + max(0.0, timeout)
+        parked = False
+        try:
+            while True:
+                newly_parked = False
+                with self._lock:
+                    busy_total = sum(self._busy.values())
+                    own = n if not parked else 0
+                    backlog = max(
+                        0, self._demand
+                        - sum(self._parked_by_tenant.values())
+                        - own - busy_total)
+                    if backlog <= 0 or backlog + n <= max_q:
+                        if parked:
+                            self._bump(self._parked_by_tenant, tenant, -n)
+                            parked = False
+                        return
+                    if not parked:
+                        parked = newly_parked = True
+                        self._bump(self._parked_by_tenant, tenant, n)
+                if newly_parked:
+                    metrics.inc("pool_admission_parked_total", label=tenant)
+                    logger.info(
+                        "action of %d tasks (tenant %r) parked at "
+                        "admission: pool backlog %d exceeds "
+                        "RDT_POOL_MAX_QUEUED=%d", n, tenant, backlog, max_q)
+                if time.monotonic() >= deadline:
+                    metrics.inc("pool_admission_rejects_total", label=tenant)
+                    metrics.record_event("admission_reject", tenant=tenant,
+                                         tasks=n, backlog=backlog,
+                                         max_queued=max_q)
+                    raise AdmissionRejected(
+                        f"admission of {n} tasks (tenant {tenant!r}) timed "
+                        f"out after {timeout:.0f}s: pool backlog of "
+                        f"{backlog} queued tasks exceeds "
+                        f"RDT_POOL_MAX_QUEUED={max_q}")
+                time.sleep(0.05)
+        finally:
+            if parked:
+                with self._lock:
+                    self._bump(self._parked_by_tenant, tenant, -n)
+
+    # ---- memory backpressure ------------------------------------------------
+    @staticmethod
+    def _store_pressure() -> Dict[str, float]:
+        """{host_id: shm bytes / budget} from the store's stats() — only
+        hosts with a configured budget report (no budget, no watermark)."""
+        stats = get_client().stats()
+        shm = stats.get("host_shm") or {}
+        return {h: shm.get(h, 0) / b
+                for h, b in (stats.get("host_budgets") or {}).items() if b}
+
+    def _pressured_hosts(self) -> frozenset:
+        """Hosts currently paused for dispatch: above the store
+        high-watermark, held until below the low-watermark (hysteresis).
+        Evaluated at most once per ``_BACKPRESSURE_POLL_S``; the cached
+        set is swapped atomically, so the dispatch hot path reads it
+        lock-free."""
+        high = float(knobs.get("RDT_STORE_HIGH_WATERMARK"))
+        if high <= 0:
+            return frozenset()
+        now = time.monotonic()
+        cached = self._pressure_cache
+        if cached is not None and now < cached[0]:
+            return cached[1]
+        with self._pressure_lock:
+            cached = self._pressure_cache
+            if cached is not None and now < cached[0]:
+                return cached[1]
+            low = min(float(knobs.get("RDT_STORE_LOW_WATERMARK")), high)
+            try:
+                provider = self.pressure_provider or self._store_pressure
+                fractions = provider() or {}
+            except Exception:  # noqa: BLE001 - no store/runtime yet, or a
+                # transient stats failure. Fail CLOSED: keep the previous
+                # pause state — an overloaded store head timing out its own
+                # stats RPC is exactly when resuming dispatch to a paused
+                # host would be wrong. (A pool that never reached a store
+                # has an empty _bp_active, so nothing is held paused.)
+                out = frozenset(self._bp_active)
+                self._pressure_cache = (now + _BACKPRESSURE_POLL_S, out)
+                return out
+            for host, frac in fractions.items():
+                if host in self._bp_active:
+                    if frac < low:
+                        self._bp_active.discard(host)
+                        metrics.record_event("backpressure", host=host,
+                                             state="resume",
+                                             pressure=round(frac, 3))
+                        logger.info(
+                            "store pressure on %s back under the low "
+                            "watermark (%.2f < %.2f); dispatch resumed",
+                            host, frac, low)
+                elif frac >= high:
+                    self._bp_active.add(host)
+                    metrics.inc("pool_backpressure_total", label=host)
+                    metrics.record_event("backpressure", host=host,
+                                         state="pause",
+                                         pressure=round(frac, 3))
+                    logger.warning(
+                        "store pressure on %s above the high watermark "
+                        "(%.2f >= %.2f); pausing dispatch to its "
+                        "executors until it drops below %.2f",
+                        host, frac, high, low)
+            # a host that stopped reporting (budget removed, node purged)
+            # must not stay paused forever
+            self._bp_active &= set(fractions)
+            out = frozenset(self._bp_active)
+            self._pressure_cache = (now + _BACKPRESSURE_POLL_S, out)
+            return out
 
     def multi_host(self) -> bool:
         """True when executors span machines — only then is locality routing
@@ -704,6 +950,8 @@ class ExecutorPool:
         payloads: Optional[Sequence[bytes]] = None,
         sched_stats: Optional[Dict[str, Any]] = None,
         on_result: Optional[Any] = None,
+        tenant: Optional[str] = None,
+        tenant_weight: Optional[float] = None,
     ) -> List[Dict[str, Any]]:
         """Run tasks, preserving order of results; blocks until all complete.
 
@@ -736,8 +984,21 @@ class ExecutorPool:
         (index into ``tasks``) — the pipelined shuffle's seal-notification
         hook: the driver publishes a map's consolidated blob the moment it
         is decided, so only winners ever seal. Callback errors are logged,
-        never fail the stage."""
+        never fail the stage.
+
+        ``tenant`` tags this stage's load for weighted fair sharing across
+        concurrent callers (doc/etl.md "Fair sharing and admission"):
+        per-tenant busy/demand twins of the pool signals, a deficit-
+        weighted dispatch gate under contention, and admission control —
+        the call parks while the pool's queued backlog would exceed
+        ``RDT_POOL_MAX_QUEUED`` and fails typed (:class:`AdmissionRejected`,
+        no-retry) past ``RDT_ADMIT_TIMEOUT_S``. ``tenant_weight`` defaults
+        to ``RDT_POOL_TENANT_WEIGHT`` (re-read per call)."""
         n = len(tasks)
+        tenant = tenant or _DEFAULT_TENANT
+        if tenant_weight is None:
+            tenant_weight = float(knobs.get("RDT_POOL_TENANT_WEIGHT"))
+        tenant_weight = max(float(tenant_weight), 1e-3)
         results: List[Optional[Dict[str, Any]]] = [None] * n
         attempts = [0] * n
         cap = max(1, max_inflight_per_executor)
@@ -841,14 +1102,15 @@ class ExecutorPool:
                 return None, None
             return best[0], best[1]
 
-        # pool-wide accounting (drain quiesce + autoscale signals), reconciled
-        # in the final ``finally`` so an abort/abandonment can never leak a
-        # phantom busy count or queued demand
+        # pool-wide accounting (drain quiesce + autoscale + fair-share
+        # signals), reconciled in the final ``finally`` so an abort/
+        # abandonment can never leak a phantom busy count, queued demand,
+        # or per-tenant load
         pool_acct: Dict[str, int] = {}
 
         def _pool_busy(ident: str, d: int) -> None:
             pool_acct[ident] = pool_acct.get(ident, 0) + d
-            self._busy_delta(ident, d)
+            self._busy_delta(ident, d, tenant)
 
         def _register(fut, i: int, ident: str, name: str, backup: bool):
             pending[fut] = _Attempt(i, ident, name, time.monotonic(), backup)
@@ -856,7 +1118,9 @@ class ExecutorPool:
             _pool_busy(ident, +1)
             copies[i] += 1
             busy_peak[name] = max(busy_peak.get(name, 0), inflight[ident])
+            self._note_dispatch(tenant)
             metrics.inc("sched_tasks_dispatched_total", label=name)
+            metrics.inc("sched_tenant_dispatched_total", label=tenant)
 
         def _submit(i: int):
             handle, ident = _choose(i)
@@ -900,9 +1164,12 @@ class ExecutorPool:
 
         def _maybe_speculate(now: float) -> Optional[float]:
             """Submit backups for straggling attempts; return seconds until
-            the next attempt becomes eligible (None = nothing to watch)."""
+            the next attempt becomes eligible (None = nothing to watch).
+            Fairness-gated like any dispatch: a backup is extra load, and
+            duplicating work while a contending tenant is under-served
+            would amplify the overload speculation is meant to dodge."""
             if not spec_on or done_cnt < spec_gate or done_cnt >= n \
-                    or not durations:
+                    or not durations or not self._fair_ok(tenant):
                 return None
             med = sorted(durations)[len(durations) // 2]
             threshold = max(spec_mult * med, spec_min_s)
@@ -937,39 +1204,57 @@ class ExecutorPool:
                             handle.name or ident, age, med)
             return next_due
 
+        def _may_dispatch() -> bool:
+            return _any_capacity() and self._fair_ok(tenant)
+
         # queued-demand signal for the autoscaler: outstanding tasks of this
-        # call, decremented as each is decided, reconciled in the finally
-        self._demand_delta(n)
+        # call, decremented as each is decided, reconciled in the finally.
+        # Registered BEFORE admission so a parked action's demand is what
+        # the autoscaler grows for.
+        self._register_tenant(tenant, tenant_weight)
+        self._demand_delta(n, tenant)
         demand_left = n
         try:
-            while next_idx < n and _any_capacity():
+            self._admit(tenant, n)
+            while next_idx < n and _may_dispatch():
                 _submit(next_idx)
                 next_idx += 1
 
             while done_cnt < n:
                 now = time.monotonic()
-                while retry_q and retry_q[0][0] <= now and _any_capacity():
+                while retry_q and retry_q[0][0] <= now and _may_dispatch():
                     _, i = heapq.heappop(retry_q)
                     if results[i] is None:
                         _submit(i)  # a backup may have won while it waited
                 spec_due = _maybe_speculate(time.monotonic())
                 if not pending:
                     if retry_q:
-                        time.sleep(max(0.0, min(
+                        delay = max(0.0, min(
                             retry_q[0][0] - time.monotonic(),
-                            _RETRY_BACKOFF_CAP_S)))
+                            _RETRY_BACKOFF_CAP_S))
+                        if delay <= 0 and not _may_dispatch():
+                            # a due retry with no slot (a full pool, or the
+                            # fair-share gate): yield instead of spinning
+                            delay = 0.05
+                        time.sleep(delay)
                         continue
                     if next_idx < n:
-                        _submit(next_idx)
-                        next_idx += 1
+                        if self._fair_ok(tenant):
+                            _submit(next_idx)
+                            next_idx += 1
+                        else:
+                            # fairness-parked with nothing in flight: wait
+                            # for the contending tenant's share to move
+                            time.sleep(0.05)
                         continue
                     break
                 # a due retry only shortens the wait when a slot is free to
                 # take it — otherwise timeout=0 would busy-spin against a
-                # full pool until some in-flight task completes; a pending
-                # speculation deadline shortens it likewise
+                # full pool (or the fair-share gate) until some in-flight
+                # task completes; a pending speculation deadline shortens
+                # it likewise
                 timeout = max(0.0, retry_q[0][0] - time.monotonic()) \
-                    if retry_q and _any_capacity() else None
+                    if retry_q and _may_dispatch() else None
                 if spec_due is not None:
                     timeout = spec_due if timeout is None \
                         else min(timeout, spec_due)
@@ -1006,7 +1291,7 @@ class ExecutorPool:
                         results[i] = r
                         done_cnt += 1
                         demand_left -= 1
-                        self._demand_delta(-1)
+                        self._demand_delta(-1, tenant)
                         durations.append(time.monotonic() - at.started)
                         if on_result is not None:
                             try:
@@ -1062,7 +1347,7 @@ class ExecutorPool:
                         tasks[i].task_id, at.name, attempts[i], delay,
                         str(err).splitlines()[0] if str(err) else err)
                     heapq.heappush(retry_q, (time.monotonic() + delay, i))
-                while next_idx < n and _any_capacity():
+                while next_idx < n and _may_dispatch():
                     _submit(next_idx)
                     next_idx += 1
         except ObjectsLostError as e:
@@ -1109,11 +1394,14 @@ class ExecutorPool:
             # still counted (losers left running, drain-abandoned
             # stragglers) stop counting as busy, and this call's undecided
             # demand is withdrawn — a failed stage must read as idle, not
-            # as a queue the autoscaler keeps growing for
-            self._demand_delta(-demand_left)
+            # as a queue the autoscaler keeps growing for. The per-tenant
+            # twins reconcile through the same two calls, so no exit path
+            # (abort, speculation losers, mid-stage drain, admission
+            # rejection) can leak phantom per-tenant load either.
+            self._demand_delta(-demand_left, tenant)
             for ident, k in pool_acct.items():
                 if k:
-                    self._busy_delta(ident, -k)
+                    self._busy_delta(ident, -k, tenant)
 
     def _drain_merge(self, pending: Dict[Any, "_Attempt"],
                      results: List[Optional[Dict[str, Any]]],
@@ -1226,13 +1514,23 @@ class Engine:
     """Thread-safe: shuffle intermediates are tracked in a per-action list
     threaded through compilation (two concurrent actions on one session must
     not cross-free each other's intermediates — the reference's Spark driver
-    supports concurrent actions)."""
+    supports concurrent actions).
+
+    ``tenant``/``tenant_weight`` tag every stage this engine dispatches for
+    the pool's weighted fair sharing (doc/etl.md "Fair sharing and
+    admission"). The tenant id is session-scoped by default (the owning
+    master's name); a second Engine over the SAME ExecutorPool with a
+    different tenant is how two user programs share one executor fleet.
+    ``tenant_weight=None`` re-reads ``RDT_POOL_TENANT_WEIGHT`` per action."""
 
     def __init__(self, pool: ExecutorPool, shuffle_partitions: int = 8,
-                 owner: Optional[str] = None):
+                 owner: Optional[str] = None, tenant: Optional[str] = None,
+                 tenant_weight: Optional[float] = None):
         self.pool = pool
         self.shuffle_partitions = shuffle_partitions
         self.owner = owner
+        self.tenant = tenant or owner or _DEFAULT_TENANT
+        self.tenant_weight = tenant_weight
         self._report_lock = threading.Lock()
         # bounded per-engine shuffle-stage ledger (one entry per wide-op
         # stage); benchmarks and tests read it through shuffle_stage_report()
@@ -1256,6 +1554,9 @@ class Engine:
         bytes_in = sum(int(r.get("shuffle_bytes_in", 0)) for r in results)
         entry = {"stage": label, "maps": len(results),
                  "buckets": num_buckets,
+                 # which tenant's action ran this stage (weighted fair
+                 # sharing across concurrent engines on one pool)
+                 "tenant": self.tenant,
                  "rows_in": rows_in, "bytes_in": bytes_in,
                  "rows_shuffled": rows, "bytes_shuffled": nbytes,
                  # store control-plane traffic: metadata (seal/lookup) and
@@ -1316,9 +1617,11 @@ class Engine:
 
     def shuffle_stage_report(self) -> List[Dict[str, Any]]:
         """Per-stage shuffle ledger: one dict per wide-op stage executed by
-        this engine ({stage, maps, buckets, rows_in, bytes_in, rows_shuffled,
-        bytes_shuffled, meta_rpcs, fetch_rpcs, consolidated, regenerated,
-        recovered}); in = entering the shuffle stage (before map-side partial
+        this engine ({stage, tenant, maps, buckets, rows_in, bytes_in,
+        rows_shuffled, bytes_shuffled, meta_rpcs, fetch_rpcs, consolidated,
+        regenerated, recovered}); ``tenant`` is the fair-share tenant the
+        stage was dispatched under (doc/etl.md "Fair sharing and
+        admission"); in = entering the shuffle stage (before map-side partial
         aggregation), shuffled = what crossed the object store.
         ``meta_rpcs``/``fetch_rpcs`` count store control-plane calls (table
         ops / payload fetches) issued by the stage's map tasks plus its
@@ -1367,6 +1670,7 @@ class Engine:
                 entry = temps.stage_entries.get(prod.label)
             if entry is None:
                 entry = {"stage": prod.label, "maps": 0, "buckets": 0,
+                         "tenant": self.tenant,
                          "rows_in": 0, "bytes_in": 0, "rows_shuffled": 0,
                          "bytes_shuffled": 0, "meta_rpcs": 0,
                          "fetch_rpcs": 0, "consolidated": False,
@@ -1767,7 +2071,9 @@ class Engine:
                         [tasks[i] for i in todo], sub_pref,
                         payloads=[blobs[i] for i in todo]
                         if blobs is not None else None,
-                        sched_stats=sched_stats, on_result=cb)
+                        sched_stats=sched_stats, on_result=cb,
+                        tenant=self.tenant,
+                        tenant_weight=self.tenant_weight)
                     for i, r in zip(todo, out):
                         results[i] = r
                     if lineage_label is not None:

@@ -20,8 +20,8 @@ Spec grammar (documented in doc/fault_tolerance.md)::
     rule       = site ':' action (':' key '=' value)*
 
     sites   : executor.run_task | shuffle.write | shuffle.fetch | store.get
-              | rpc.call | estimator.epoch | serve.predict | pool.drain
-              | pool.scale
+              | store.spill | rpc.call | estimator.epoch | serve.predict
+              | pool.drain | pool.scale
               (env specs must name a KNOWN_SITES entry)
     actions : crash | delay | raise | drop | connloss   (interpreted by the site)
     keys    : nth= every= p= times= seed= match= once= ms= ms_per_mb= bucket=
@@ -72,6 +72,7 @@ KNOWN_SITES = frozenset((
     "shuffle.write",
     "shuffle.fetch",
     "store.get",
+    "store.spill",
     "rpc.call",
     "estimator.epoch",
     "serve.predict",
@@ -84,7 +85,7 @@ KNOWN_SITES = frozenset((
 #: a drop armed at rpc.call would claim its sentinel and inject nothing,
 #: the same silent-no-op the action-name check exists to prevent
 SITE_SPECIFIC_ACTIONS = {
-    "drop": ("shuffle.write", "store.get", "shuffle.fetch"),
+    "drop": ("shuffle.write", "store.get", "shuffle.fetch", "store.spill"),
     "connloss": ("rpc.call",),
 }
 

@@ -159,6 +159,27 @@ _ALL = [
     _k("RDT_DRAIN_TIMEOUT_S", "float", 30.0, PER_ACTION, "etl",
        "How long a drain waits for the retiring executor's in-flight tasks "
        "before abandoning them to the normal retry/recovery machinery."),
+    # ---- multi-tenant overload robustness -----------------------------------
+    _k("RDT_POOL_TENANT_WEIGHT", "float", 1.0, PER_ACTION, "etl",
+       "Fair-share weight of this action's tenant: under contention each "
+       "tenant's in-flight share tracks weight/sum(weights). Engine-level "
+       "tenant_weight= overrides per tenant."),
+    _k("RDT_POOL_MAX_QUEUED", "int", 0, PER_ACTION, "etl",
+       "Admission bound on the pool's queued (admitted, not yet in-flight) "
+       "backlog: an action that would push past it parks at admission — "
+       "visible to the autoscaler — instead of flooding dispatch. 0 "
+       "disables admission control."),
+    _k("RDT_ADMIT_TIMEOUT_S", "float", 30.0, PER_ACTION, "etl",
+       "How long an action parks at admission before failing with the "
+       "typed, no-retry AdmissionRejected."),
+    _k("RDT_STORE_HIGH_WATERMARK", "float", 1.25, PER_ACTION, "etl",
+       "Memory backpressure trip point: dispatch to a host whose store "
+       "shm use exceeds this fraction of its budget pauses (spill is not "
+       "keeping up). <= 0 disables backpressure."),
+    _k("RDT_STORE_LOW_WATERMARK", "float", 0.95, PER_ACTION, "etl",
+       "Memory backpressure release point: a paused host re-enters "
+       "dispatch once its shm use drops below this fraction of its "
+       "budget."),
     # ---- training / feed ----------------------------------------------------
     _k("RDT_PREFETCH_TO_DEVICE", "int", 2, PER_ACTION, "training",
        "Already-device_put batches the streaming feed keeps ahead of the "
@@ -204,6 +225,12 @@ _ALL = [
        "Staged batches a replica keeps decoded + device-placed ahead of "
        "its jitted apply (the DevicePrefetcher depth). Read at replica "
        "load."),
+    _k("RDT_SERVE_MAX_QUEUE", "int", 1024, PER_ACTION, "serving",
+       "Overload bound on outstanding (accepted, unfinished) requests: "
+       "past it predict_async sheds with the typed retriable "
+       "ServingOverloaded instead of growing the dispatcher queue, and "
+       "hedging is suppressed while saturated. 0 disables shedding. Read "
+       "at serving-session construction."),
     # ---- runtime ------------------------------------------------------------
     _k("RDT_LOG_LEVEL", "str", "INFO", PROCESS_START, "runtime",
        "Log level of spawned processes (node agents, SPMD rank workers)."),

@@ -117,6 +117,20 @@ _ALL_METRICS = [
        "Executors the autoscale controller added to the pool."),
     _m("pool_scaled_down_total", COUNTER, "1", "scheduler",
        "Executors the autoscale controller drained out of the pool."),
+    _m("sched_tenant_dispatched_total", COUNTER, "1", "scheduler",
+       "Task attempts dispatched per tenant (the fair-share observability "
+       "column: under contention the per-tenant rates track the "
+       "configured weights).", label="tenant"),
+    _m("pool_admission_parked_total", COUNTER, "1", "scheduler",
+       "Actions that parked at admission because the pool's queued "
+       "backlog exceeded RDT_POOL_MAX_QUEUED.", label="tenant"),
+    _m("pool_admission_rejects_total", COUNTER, "1", "scheduler",
+       "Actions failed with AdmissionRejected after parking past "
+       "RDT_ADMIT_TIMEOUT_S.", label="tenant"),
+    _m("pool_backpressure_total", COUNTER, "1", "scheduler",
+       "Times dispatch to a host paused on the store high-watermark "
+       "(memory backpressure trip transitions, not per-task skips).",
+       label="host"),
     _m("recovery_rounds_total", COUNTER, "1", "recovery",
        "Lineage-recovery rounds that re-executed producers."),
     _m("recovery_blobs_regenerated_total", COUNTER, "1", "recovery",
@@ -167,6 +181,9 @@ _ALL_METRICS = [
     _m("serve_failed_total", COUNTER, "1", "serving",
        "Requests failed after every replica refused within the re-route "
        "grace (ServingError)."),
+    _m("serve_shed_total", COUNTER, "1", "serving",
+       "Requests refused at admission with the typed retriable "
+       "ServingOverloaded (outstanding queue at RDT_SERVE_MAX_QUEUE)."),
     _m("serve_queue_depth", GAUGE, "1", "serving",
        "Pending + in-flight dispatcher work per serving session, refreshed "
        "on every dispatcher loop pass (an idle session reads 0).",
@@ -276,6 +293,12 @@ _ALL_EVENTS = [
        "(direction + resulting size)."),
     _e("stage_abort", "scheduler",
        "A failing stage ran the abort contract (drain + free)."),
+    _e("admission_reject", "scheduler",
+       "An action parked at admission timed out (RDT_ADMIT_TIMEOUT_S) and "
+       "failed with the typed no-retry AdmissionRejected."),
+    _e("backpressure", "scheduler",
+       "Dispatch to a host paused on the store high-watermark, or resumed "
+       "below the low-watermark (memory backpressure transitions)."),
     _e("action_failed", "engine",
        "An engine action surfaced a StageError; a blackbox bundle is "
        "written alongside."),
@@ -289,6 +312,9 @@ _ALL_EVENTS = [
     _e("request_failed", "serving",
        "A serving request failed on every replica within the re-route "
        "grace (ServingError)."),
+    _e("overload_shed", "serving",
+       "A serving request was refused at admission (ServingOverloaded) "
+       "because the session's outstanding queue was at its bound."),
 ]
 
 EVENTS: Dict[str, Event] = {e.kind: e for e in _ALL_EVENTS}

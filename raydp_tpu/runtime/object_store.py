@@ -734,7 +734,21 @@ class ObjectStoreServer:
                 if e is None or e.spilled or e.host_id != host_id:
                     return False
                 segment, offset, size = e.segment, e.offset, e.size
+            # chaos site: checked only after the victim is validated — a
+            # raced no-op spill (victim freed / already spilled) must not
+            # consume the schedule (nth/times/once) while injecting
+            # nothing. ``drop`` deletes the spill FILE after the commit
+            # (the lost-disk model — the next fault-in surfaces the typed
+            # loss into lineage recovery); delay/raise model slow/failing
+            # spill IO and are applied INSIDE the write try, so an
+            # injected raise fails just this spill (warning + object stays
+            # in shm) instead of escaping into the seal path after the
+            # table entry was committed.
+            rule = faults.check("store.spill", key=object_id)
+            drop_after = rule is not None and rule.action == "drop"
             try:
+                if rule is not None and not drop_after:
+                    faults.apply(rule, "store.spill")
                 write_spill(object_id, segment, offset, size)
             except Exception as exc:
                 logger.warning("spill of %s on %s failed: %s",
@@ -763,6 +777,11 @@ class ObjectStoreServer:
         except Exception as exc:
             logger.warning("post-spill release on %s failed: %s",
                            host_id, exc)
+        if drop_after:
+            try:
+                remove_spill(object_id)
+            except Exception:  # noqa: BLE001 - injection must not mask IO
+                pass
         return True
 
     def _fault_in(self, host_id: str, object_id: str) -> None:
@@ -784,7 +803,26 @@ class ObjectStoreServer:
             self._fault_gen += 1
             seg_name = (f"rdt{self.session_id[:8]}_{object_id[:20]}"
                         f"g{self._fault_gen}")
-            segment, offset = fault_read(object_id, seg_name)
+            try:
+                segment, offset = fault_read(object_id, seg_name)
+            except Exception as exc:
+                if not (isinstance(exc, FileNotFoundError)
+                        or getattr(exc, "exc_type", None)
+                        == "FileNotFoundError"):
+                    raise
+                # the spill FILE is gone (disk loss, node wipe) — not a
+                # lost RPC reply: the payload is unrecoverable here.
+                # Surface the typed loss (→ lineage recovery) and drop the
+                # zombie table entry so later readers miss fast instead of
+                # re-probing a file that will never return
+                with self._lock:
+                    e = self._table.get(object_id)
+                    if e is not None and e.spilled:
+                        del self._table[object_id]
+                        self._spilled_bytes -= e.size
+                raise ObjectLostError(
+                    object_id, f"spill file lost on {host_id}: {exc}") \
+                    from exc
             with self._lock:
                 e = self._table.get(object_id)
                 if e is None:  # freed mid-fault-in: drop the fresh shm
@@ -1035,6 +1073,9 @@ class ObjectStoreServer:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
+            budgets: Dict[str, int] = dict(self._host_budgets)
+            if self.shm_budget and self.spill_dir is not None:
+                budgets[HEAD_HOST] = int(self.shm_budget)
             return {
                 "num_objects": len(self._table),
                 "total_bytes": sum(e.size for e in self._table.values()),
@@ -1044,6 +1085,12 @@ class ObjectStoreServer:
                 "spilled_bytes": self._spilled_bytes,
                 "spilled_objects": sum(1 for e in self._table.values()
                                        if e.spilled),
+                # per-host shm footprint + budgets: what the engine's
+                # memory backpressure (doc/etl.md "Fair sharing and
+                # admission") reads its watermark fractions from
+                "host_shm": {HEAD_HOST: self._shm_bytes,
+                             **dict(self._host_bytes)},
+                "host_budgets": budgets,
             }
 
     def owned_by(self, owner: str) -> List[str]:

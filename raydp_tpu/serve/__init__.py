@@ -11,8 +11,8 @@ from raydp_tpu.serve.servable import (  # noqa: F401
     Servable, export_bundle, load_servable,
 )
 from raydp_tpu.serve.session import (  # noqa: F401
-    ServingError, ServingSession,
+    ServingError, ServingOverloaded, ServingSession,
 )
 
-__all__ = ["Servable", "ServingError", "ServingSession", "export_bundle",
-           "load_servable"]
+__all__ = ["Servable", "ServingError", "ServingOverloaded",
+           "ServingSession", "export_bundle", "load_servable"]

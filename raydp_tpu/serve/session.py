@@ -63,6 +63,14 @@ class ServingError(RuntimeError):
     """A request failed on every live replica within the re-route grace."""
 
 
+class ServingOverloaded(ServingError):
+    """A request was shed at admission: the session's outstanding queue
+    (accepted, unfinished requests) is at ``RDT_SERVE_MAX_QUEUE``. Typed
+    and RETRIABLE by contract — unlike :class:`ServingError` this is not a
+    verdict on the request, only on the moment: the queue drains as
+    batches complete, so back off and retry (or route elsewhere)."""
+
+
 #: ``RemoteError.exc_type`` values that mark a replica/infrastructure
 #: failure worth re-routing: a restarted executor's empty registry, and the
 #: chaos plane's transient ``raise`` (doc/serving.md failure table). Any
@@ -197,7 +205,8 @@ class ServingSession:
     ``RDT_SERVE_MAX_INFLIGHT``, hedging ``RDT_SERVE_HEDGE`` /
     ``RDT_SERVE_HEDGE_QUANTILE`` / ``RDT_SERVE_HEDGE_MULTIPLIER`` /
     ``RDT_SERVE_HEDGE_MIN_MS``, fault path ``RDT_SERVE_REROUTE_GRACE_S``,
-    replica staging ``RDT_SERVE_PREFETCH``."""
+    overload shedding ``RDT_SERVE_MAX_QUEUE``, replica staging
+    ``RDT_SERVE_PREFETCH``."""
 
     def __init__(self, export_dir: str, session=None,
                  executors: Optional[List] = None,
@@ -237,6 +246,14 @@ class ServingSession:
         self._hedge_min_s = max(
             0.0, float(knobs.get("RDT_SERVE_HEDGE_MIN_MS")) / 1000.0)
         self._reroute_grace_s = float(knobs.get("RDT_SERVE_REROUTE_GRACE_S"))
+        self._max_queue = max(0, int(knobs.get("RDT_SERVE_MAX_QUEUE")))
+        # overload shedding state — touched from REQUEST threads (admission
+        # in predict_async, decrements from future callbacks), never by the
+        # dispatcher alone, so unlike the dispatcher-owned state below it
+        # needs its own lock
+        self._adm_lock = threading.Lock()
+        self._outstanding = 0  # guarded-by: _adm_lock
+        self._shed_count = 0   # guarded-by: _adm_lock
 
         self._replicas: List[_ReplicaState] = []
         loads = []
@@ -275,7 +292,13 @@ class ServingSession:
     def predict_async(self, rows) -> Future:
         """Enqueue rows (Table / DataFrame / dict of arrays); the Future
         resolves to a float32 prediction array, one entry per input row.
-        Thread-safe; callable from any number of request threads."""
+        Thread-safe; callable from any number of request threads.
+
+        Overload shedding: past ``RDT_SERVE_MAX_QUEUE`` outstanding
+        (accepted, unfinished) requests this fails fast with the typed
+        retriable :class:`ServingOverloaded` instead of growing the
+        dispatcher queue without bound — a burst degrades to rejections,
+        never to a collapsing dispatcher (doc/serving.md "Overload")."""
         table = _as_table(rows)
         fut: Future = Future()
         if table.num_rows == 0:
@@ -283,6 +306,27 @@ class ServingSession:
             return fut
         if self._closed:
             raise ServingError("serving session is closed")
+        with self._adm_lock:
+            if self._max_queue > 0 and self._outstanding >= self._max_queue:
+                self._shed_count += 1
+                outstanding = self._outstanding
+                shed = True
+            else:
+                self._outstanding += 1
+                shed = False
+        if shed:
+            metrics.inc("serve_shed_total")
+            metrics.record_event("overload_shed", session=self.name,
+                                 outstanding=outstanding,
+                                 max_queue=self._max_queue)
+            raise ServingOverloaded(
+                f"serving session {self.name!r} is saturated "
+                f"({outstanding} outstanding requests >= "
+                f"RDT_SERVE_MAX_QUEUE={self._max_queue}); retry with "
+                "backoff")
+        # whichever way the request ends (demuxed result, re-route
+        # exhaustion, close) the admission slot releases with its future
+        fut.add_done_callback(self._release_admission)
         self._events.put(("req", _Request(table, fut)))
         if self._closed and not fut.done():
             # close() raced the enqueue: the request may sit behind the
@@ -299,6 +343,19 @@ class ServingSession:
     def predict(self, rows, timeout: Optional[float] = None) -> np.ndarray:
         """Synchronous :meth:`predict_async`."""
         return self.predict_async(rows).result(timeout=timeout)
+
+    def _release_admission(self, _fut) -> None:
+        with self._adm_lock:
+            self._outstanding = max(0, self._outstanding - 1)
+
+    def _shedding(self) -> bool:
+        """Saturated right now? While True the dispatcher suppresses
+        hedging — a hedge is a duplicate dispatch, and duplicating work
+        while shedding new requests amplifies exactly the overload the
+        shed exists to absorb."""
+        with self._adm_lock:
+            return self._max_queue > 0 \
+                and self._outstanding >= self._max_queue
 
     def serving_report(self) -> Dict[str, Any]:
         """Counters + latency snapshot (the ``shuffle_stage_report`` twin
@@ -777,6 +834,8 @@ class ServingSession:
                    self._hedge_min_s)
 
     def _maybe_hedge(self) -> None:
+        if self._shedding():
+            return  # hedges amplify overload; suppressed while saturated
         deadline = self._hedge_deadline()
         if deadline is None:
             return
@@ -800,7 +859,17 @@ class ServingSession:
         lat = sorted(self._req_lat)
         occ = self._occupancy
         out = dict(self._stats)
+        with self._adm_lock:
+            shed = self._shed_count
+            outstanding = self._outstanding
+        # a shed request IS a failed request from the caller's view, so
+        # ``failed`` includes ``shed`` — a clean overload run reads
+        # failed == shed (nothing failed except typed rejections)
+        out["shed"] = shed
+        out["failed"] = out["failed"] + shed
         out.update({
+            "outstanding": outstanding,
+            "max_queue": self._max_queue,
             "p50_ms": round(_quantile(lat, 0.50) * 1000.0, 3),
             "p99_ms": round(_quantile(lat, 0.99) * 1000.0, 3),
             "mean_batch_occupancy": (round(sum(occ) / len(occ), 2)

@@ -428,6 +428,7 @@ def test_note_recovery_attribution_unit():
     eng = Engine.__new__(Engine)
     eng._report_lock = threading.Lock()
     eng._stage_reports = collections.deque(maxlen=256)
+    eng.tenant = "unit"
 
     def record(temps, label, ref_id):
         prod = _Producer(b"", [ref_id], label)
@@ -1133,3 +1134,341 @@ def test_serving_replica_crash_reroutes_zero_dropped(tmp_path):
     # neither the crash nor the changed batch composition may leak into
     # the numbers)
     assert np.array_equal(results["clean"], results["crash"])
+
+
+# ==== multi-tenant overload robustness (ISSUE 14) ============================
+
+def _wide_pdf(n=16000):
+    rng = np.random.RandomState(0)
+    return pd.DataFrame({"k": rng.randint(0, 50, n),
+                         "v": rng.randint(0, 1000, n).astype(np.int64)})
+
+
+def test_spilled_blob_file_lost_mid_join_recovers(tmp_path, monkeypatch):
+    """Chaos leg (ROADMAP item 4's missing fault proof): a spilled shuffle
+    blob's DISK FILE is deleted mid-join (``store.spill:drop`` — the
+    lost-disk model). The reduce side's transparent fault-in misses the
+    file, ``_fault_in`` surfaces the typed ``ObjectLostError``, lineage
+    recovery regenerates the map blob — byte-identical to a spill-free
+    fault-free run, zero orphans. Parquet inputs keep the store holding
+    ONLY intermediates, so every spill victim is lineage-recoverable."""
+    from raydp_tpu import config as cfg
+
+    monkeypatch.setenv("RDT_ETL_AQE", "0")  # a broadcast join skips spill
+    rng = np.random.RandomState(0)
+    for side, col in (("L", "v"), ("R", "w")):
+        for i in range(2):
+            pdf = pd.DataFrame(
+                {"k": rng.randint(0, 200, 6000),
+                 col: rng.randint(0, 1000, 6000).astype(np.int64)})
+            pdf.to_parquet(str(tmp_path / f"{side}{i}.parquet"))
+
+    def run(app, budget=None):
+        from raydp_tpu.runtime.object_store import get_client
+
+        s = raydp_tpu.init(
+            app, num_executors=2, executor_cores=1, executor_memory="512MB",
+            configs={cfg.SPILL_BUDGET_KEY: str(budget)} if budget else None)
+        try:
+            client = get_client()
+            before = client.stats()["num_objects"]
+            dfl = s.read.parquet([str(tmp_path / "L0.parquet"),
+                                  str(tmp_path / "L1.parquet")])
+            dfr = s.read.parquet([str(tmp_path / "R0.parquet"),
+                                  str(tmp_path / "R1.parquet")])
+            out = dfl.join(dfr, on="k")
+            table = s.engine.collect(out._plan).sort_by(
+                [("k", "ascending"), ("v", "ascending"), ("w", "ascending")])
+            deadline = time.time() + 30
+            while time.time() < deadline \
+                    and client.stats()["num_objects"] != before:
+                time.sleep(0.2)
+            report = s.engine.shuffle_stage_report()
+            return (_ipc_bytes(table),
+                    client.stats()["num_objects"] - before, report)
+        finally:
+            raydp_tpu.stop()
+
+    base, orphans0, _ = run("spill-join-base")
+    assert orphans0 == 0
+
+    sent = str(tmp_path / "spill-drop.sentinel")
+    monkeypatch.setenv("RDT_FAULTS", f"store.spill:drop:nth=1:once={sent}")
+    got, orphans, report = run("spill-join-chaos", budget=250_000)
+    assert os.path.exists(sent), "store.spill drop never fired"
+    assert got == base, "recovered join diverged from the fault-free run"
+    assert orphans == 0, f"spill-loss recovery orphaned {orphans} objects"
+    assert sum(e.get("recovered", 0) for e in report) >= 1, report
+    assert sum(e.get("regenerated", 0) for e in report) >= 1, report
+
+
+def test_flood_and_interactive_tenants_share_pool(tmp_path, monkeypatch):
+    """Fairness chaos leg: a flooding tenant (a wide, per-map-delayed
+    groupagg) and an interactive tenant (the canonical small groupagg)
+    share ONE pool via two engines. The interactive action completes while
+    the flood still has queued work (bounded latency — it never waits out
+    the flood's queue), both tenants' results are byte-identical to
+    uncontended runs, the per-tenant columns surface in load() and the
+    stage report, and the store audit shows zero orphans."""
+    from raydp_tpu.etl.engine import Engine
+
+    # uncontended baselines (fault-free, fixed pool)
+    s = _session3("chaos-fair-base")
+    try:
+        small = _frame(s)
+        out_s = small.groupBy("k").agg(F.sum("v").alias("s"),
+                                       F.count("v").alias("n"))
+        base_small = _ipc_bytes(s.engine.collect(out_s._plan)
+                                .sort_by([("k", "ascending")]))
+        wide = s.createDataFrame(_wide_pdf(), num_partitions=48)
+        out_w = wide.groupBy("k").agg(F.sum("v").alias("s"),
+                                      F.count("v").alias("n"))
+        base_wide = _ipc_bytes(s.engine.collect(out_w._plan)
+                               .sort_by([("k", "ascending")]))
+    finally:
+        raydp_tpu.stop()
+
+    # contended run: per-map delay stretches the flood (48 delayed maps
+    # over 12 slots = several waves) so the interactive action demonstrably
+    # overlaps it
+    monkeypatch.setenv("RDT_FAULTS",
+                       "executor.run_task:delay:ms=200:match=|mt-")
+    s = _session3("chaos-fair")
+    try:
+        from raydp_tpu.runtime.object_store import get_client
+        client = get_client()
+        small = _frame(s)
+        out_s = small.groupBy("k").agg(F.sum("v").alias("s"),
+                                       F.count("v").alias("n"))
+        # the flood is a SECOND tenant on the same pool: a second engine
+        # over the session's executors, wide input (16 delayed maps)
+        flood_eng = Engine(s.engine.pool,
+                           shuffle_partitions=s.engine.shuffle_partitions,
+                           owner=s.engine.owner, tenant="flood")
+        wide = s.createDataFrame(_wide_pdf(), num_partitions=48)
+        out_w = wide.groupBy("k").agg(F.sum("v").alias("s"),
+                                      F.count("v").alias("n"))
+        before = client.stats()["num_objects"]
+        box = {}
+
+        def flood():
+            try:
+                box["wide"] = _ipc_bytes(flood_eng.collect(out_w._plan)
+                                         .sort_by([("k", "ascending")]))
+            except Exception as e:  # noqa: BLE001 - asserted below
+                box["error"] = e
+
+        t = threading.Thread(target=flood)
+        t.start()
+        deadline = time.time() + 30
+        while time.time() < deadline \
+                and (s.engine.pool.load()["tenants"]
+                     .get("flood", {}).get("queued", 0)) < 4:
+            time.sleep(0.02)  # the flood has saturated + queued
+        t0 = time.monotonic()
+        got_small = _ipc_bytes(s.engine.collect(out_s._plan)
+                               .sort_by([("k", "ascending")]))
+        inter_wall = time.monotonic() - t0
+        load_at_finish = s.engine.pool.load()
+        t.join(timeout=300)
+        assert "error" not in box, box.get("error")
+        # bounded latency: the interactive action finished while the flood
+        # still had queued work — it shared slots instead of queueing behind
+        flood_row = load_at_finish["tenants"].get("flood", {})
+        assert flood_row.get("queued", 0) > 0, load_at_finish
+        assert inter_wall < 20.0, f"interactive starved: {inter_wall:.1f}s"
+        # per-tenant observability: both tenants' dispatch counts surface,
+        # and the stage report carries the tenant column
+        tenants = load_at_finish["tenants"]
+        assert tenants[s.master_name]["dispatched"] >= 1
+        assert tenants["flood"]["dispatched"] >= 1
+        rep = s.engine.shuffle_stage_report() + \
+            flood_eng.shuffle_stage_report()
+        assert {e["tenant"] for e in rep} >= {s.master_name, "flood"}
+        # accepted results byte-identical to the uncontended runs
+        assert got_small == base_small
+        assert box["wide"] == base_wide
+        deadline = time.time() + 30
+        while time.time() < deadline \
+                and client.stats()["num_objects"] != before:
+            time.sleep(0.25)
+        orphans = client.stats()["num_objects"] - before
+        assert orphans == 0, f"contended run orphaned {orphans} objects"
+    finally:
+        raydp_tpu.stop()
+
+
+def test_serving_overload_burst_sheds_typed(tmp_path, monkeypatch):
+    """Serving overload chaos leg: a burst far past RDT_SERVE_MAX_QUEUE
+    against a deliberately slowed replica sheds with the typed retriable
+    ServingOverloaded — the dispatcher stays alive (accepted requests all
+    complete, a post-burst request is served), accepted results are
+    byte-identical to an uncontended run, and the report shows
+    failed == shed only."""
+    import optax
+
+    from raydp_tpu.models import MLP
+    from raydp_tpu.serve import ServingOverloaded, ServingSession
+    from raydp_tpu.train import FlaxEstimator
+
+    rng = np.random.RandomState(11)
+    x = rng.random_sample((256, 2))
+    y = x @ np.array([2.0, -3.0]) + 1.0
+    pdf = pd.DataFrame({"x1": x[:, 0], "x2": x[:, 1], "y": y})
+    export_dir = str(tmp_path / "overload-servable")
+
+    monkeypatch.setenv("RDT_SERVE_BATCH_TIMEOUT_MS", "5")
+    # armed BEFORE init so the spawned executors (where serve.predict
+    # fires) inherit the delay; it slows every replica apply by 120ms,
+    # which cannot change the jitted numbers — only the queue dynamics
+    monkeypatch.setenv("RDT_FAULTS", "serve.predict:delay:ms=120")
+    s = _session("serve_overload")
+    try:
+        df = s.createDataFrame(pdf, num_partitions=2)
+        est = FlaxEstimator(model=MLP(features=(8,), use_batch_norm=False),
+                            optimizer=optax.adam(1e-2), loss="mse",
+                            feature_columns=["x1", "x2"], label_column="y",
+                            batch_size=64, num_epochs=1)
+        est.fit_on_frame(df)
+        est.export_serving(export_dir)
+
+        # uncontended reference predictions (shedding off)
+        monkeypatch.setenv("RDT_SERVE_MAX_QUEUE", "0")
+        with ServingSession(export_dir, session=s, name="ref",
+                            num_replicas=1) as ref:
+            expect = [ref.predict({"x1": x[i:i + 2, 0],
+                                   "x2": x[i:i + 2, 1]}, timeout=60.0)
+                      for i in range(0, 64, 2)]
+
+        # overload run: the same slow replicas + a tight queue bound
+        monkeypatch.setenv("RDT_SERVE_MAX_QUEUE", "6")
+        srv = ServingSession(export_dir, session=s, name="overload",
+                             num_replicas=1)
+        try:
+            accepted, shed = [], 0
+            for i in range(0, 64, 2):
+                try:
+                    accepted.append(
+                        (i // 2, srv.predict_async({"x1": x[i:i + 2, 0],
+                                                    "x2": x[i:i + 2, 1]})))
+                except ServingOverloaded:
+                    shed += 1
+            assert shed >= 1, "burst never shed"
+            assert len(accepted) >= 6
+            for idx, fut in accepted:
+                got = fut.result(timeout=120.0)
+                assert np.array_equal(got, expect[idx]), idx
+            rep = srv.serving_report()
+            assert rep["shed"] == shed
+            assert rep["failed"] == rep["shed"], rep  # failed == shed ONLY
+            # the dispatcher survived the burst: a fresh request serves
+            tail = srv.predict({"x1": x[:2, 0], "x2": x[:2, 1]},
+                               timeout=60.0)
+            assert np.array_equal(tail, expect[0])
+            from raydp_tpu import metrics
+            assert "overload_shed" in [e["kind"] for e in metrics.events()]
+        finally:
+            srv.close()
+    finally:
+        raydp_tpu.stop()
+
+
+def test_admission_composes_with_autoscale_and_drain(tmp_path, monkeypatch):
+    """Admission chaos leg: a flooding tenant pushes the pool backlog past
+    RDT_POOL_MAX_QUEUED so a second action PARKS at admission; the
+    autoscaler (armed, fast cadence) sees the parked demand and grows the
+    pool; a concurrent graceful drain retires an executor mid-flood. Both
+    actions complete byte-identical to uncontended baselines, the parked
+    action was admitted (never rejected), and the store audit shows zero
+    orphans."""
+    s = _session("chaos-admit-base")
+    try:
+        df = _frame(s)
+        out = df.groupBy("k").agg(F.sum("v").alias("s"),
+                                  F.count("v").alias("n"))
+        base_small = _ipc_bytes(s.engine.collect(out._plan)
+                                .sort_by([("k", "ascending")]))
+        wide = s.createDataFrame(_wide_pdf(), num_partitions=48)
+        out_w = wide.groupBy("k").agg(F.sum("v").alias("s"),
+                                      F.count("v").alias("n"))
+        base_wide = _ipc_bytes(s.engine.collect(out_w._plan)
+                               .sort_by([("k", "ascending")]))
+    finally:
+        raydp_tpu.stop()
+
+    monkeypatch.setenv("RDT_POOL_MAX_QUEUED", "8")
+    monkeypatch.setenv("RDT_ADMIT_TIMEOUT_S", "120")
+    monkeypatch.setenv("RDT_POOL_SCALE_INTERVAL_S", "0.2")
+    monkeypatch.setenv("RDT_POOL_SCALE_UP_S", "0.3")
+    monkeypatch.setenv("RDT_POOL_IDLE_S", "60")
+    monkeypatch.setenv("RDT_POOL_COOLDOWN_S", "0.5")
+    monkeypatch.setenv("RDT_FAULTS",
+                       "executor.run_task:delay:ms=200:match=|mt-")
+    s = _session3("chaos-admit")
+    try:
+        from raydp_tpu.runtime.object_store import get_client
+        client = get_client()
+        auto = s.autoscale(min_size=1, max_size=4)
+        df = _frame(s)
+        out = df.groupBy("k").agg(F.sum("v").alias("s"),
+                                  F.count("v").alias("n"))
+        wide = s.createDataFrame(_wide_pdf(), num_partitions=48)
+        out_w = wide.groupBy("k").agg(F.sum("v").alias("s"),
+                                      F.count("v").alias("n"))
+        before = client.stats()["num_objects"]
+        box = {}
+
+        def flood():
+            try:
+                box["wide"] = _ipc_bytes(s.engine.collect(out_w._plan)
+                                         .sort_by([("k", "ascending")]))
+            except Exception as e:  # noqa: BLE001 - asserted below
+                box["flood_error"] = e
+
+        def late():
+            try:
+                box["small"] = _ipc_bytes(s.engine.collect(out._plan)
+                                          .sort_by([("k", "ascending")]))
+            except Exception as e:  # noqa: BLE001 - asserted below
+                box["late_error"] = e
+
+        tf = threading.Thread(target=flood)
+        tf.start()
+        deadline = time.time() + 30
+        while time.time() < deadline \
+                and s.engine.pool.load()["queued"] <= 8:
+            time.sleep(0.02)  # flood backlog past the admission bound
+        tl = threading.Thread(target=late)
+        tl.start()
+        # the late action parks at admission (visible in load())
+        deadline = time.time() + 20
+        parked_seen = 0
+        while time.time() < deadline:
+            parked_seen = max(parked_seen, s.engine.pool.load()["parked"])
+            if parked_seen:
+                break
+            time.sleep(0.02)
+        # concurrent drain while the flood runs and the late action parks
+        s.retire_executor(s.executors[-1].name)
+        tf.join(timeout=300)
+        tl.join(timeout=300)
+        assert "flood_error" not in box, box.get("flood_error")
+        assert "late_error" not in box, box.get("late_error")
+        assert parked_seen > 0, "late action never parked at admission"
+        assert box["wide"] == base_wide
+        assert box["small"] == base_small
+        # the autoscaler grew for the parked/queued demand
+        assert any(e["direction"] == "up" for e in auto.events), auto.events
+        deadline = time.time() + 30
+        while time.time() < deadline \
+                and client.stats()["num_objects"] != before:
+            time.sleep(0.25)
+        orphans = client.stats()["num_objects"] - before
+        assert orphans == 0, f"admission+scale+drain orphaned {orphans}"
+        from raydp_tpu import metrics
+        snap = metrics.snapshot()["counters"]
+        assert snap.get("pool_admission_parked_total", {}), snap
+        assert not snap.get("pool_admission_rejects_total", {}), \
+            "the parked action was rejected instead of admitted"
+    finally:
+        raydp_tpu.stop()

@@ -483,3 +483,330 @@ def test_session_autoscale_grow_and_shrink_live(monkeypatch):
         assert len(set(results)) == 1, "burst results diverged"
     finally:
         raydp_tpu.stop()
+
+
+# ==== multi-tenant fair sharing / admission / backpressure (ISSUE 14) ========
+
+def test_fair_gate_unit():
+    """The deficit-weighted dispatch gate, driven by hand-set pool state:
+    the least-served tenant always passes; a tenant past weight x the
+    minimum contending share is held; no contention = no gate."""
+    pool = ExecutorPool([StubExecutor(name="a")])
+    # no other tenant with queued work: always allowed
+    assert pool._fair_ok("flood")
+    with pool._lock:
+        pool._tenant_weight.update({"flood": 1.0, "inter": 1.0})
+        pool._tenant_busy.update({"flood": 5, "inter": 3})
+        pool._tenant_demand.update({"flood": 100, "inter": 10})
+    assert not pool._fair_ok("flood"), "over-served tenant not held"
+    assert pool._fair_ok("inter"), "least-served tenant was held"
+    # weighted: inter at weight 3 may run 3x flood's share
+    with pool._lock:
+        pool._tenant_weight["inter"] = 3.0
+        pool._tenant_busy.update({"flood": 2, "inter": 6})
+    assert pool._fair_ok("flood") and pool._fair_ok("inter")
+    with pool._lock:
+        pool._tenant_busy.update({"flood": 3, "inter": 5})
+    assert not pool._fair_ok("flood")
+    # the contender going fully idle (demand == busy) lifts the gate
+    with pool._lock:
+        pool._tenant_demand["inter"] = 5
+    assert pool._fair_ok("flood")
+
+
+def test_fair_share_interactive_not_starved(monkeypatch):
+    """A flooding tenant with hundreds of queued tasks shares the pool with
+    an interactive tenant: the interactive stage's handful of tasks
+    completes in bounded time instead of waiting out the flood's queue."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    pool = ExecutorPool([StubExecutor(name="e0", latency=0.01),
+                         StubExecutor(name="e1", latency=0.01)])
+    done = {}
+
+    def flood():
+        done["flood"] = pool.run_tasks(
+            _tasks(300), max_inflight_per_executor=2,
+            payloads=_payloads(300), tenant="flood")
+
+    t = threading.Thread(target=flood)
+    t.start()
+    deadline = time.monotonic() + 5
+    while pool.load()["queued"] < 50 and time.monotonic() < deadline:
+        time.sleep(0.01)  # the flood is saturating the pool
+    t0 = time.monotonic()
+    out = pool.run_tasks(_tasks(8), max_inflight_per_executor=2,
+                         payloads=_payloads(8), tenant="interactive")
+    wall = time.monotonic() - t0
+    t.join(timeout=60)
+    assert all(r is not None for r in out)
+    assert all(r is not None for r in done["flood"])
+    # 8 tasks x 10ms on a fair half of 4 slots is ~40ms; without the gate
+    # they would wait out ~300 queued flood tasks (~1.5s+)
+    assert wall < 1.0, f"interactive tenant starved ({wall:.2f}s)"
+    tenants = pool.load()["tenants"]
+    assert tenants["interactive"]["dispatched"] == 8
+    assert tenants["flood"]["busy"] == 0 and tenants["flood"]["queued"] == 0
+
+
+def test_fair_share_tracks_weights(monkeypatch):
+    """Two saturating tenants at weights 3:1: the observed dispatch split
+    while both contend tracks the weight ratio within tolerance."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    # 16 slots: wide enough that the gate's one-task slack per tenant is
+    # small against the ideal 12/4 split (at 4 slots it would dominate)
+    pool = ExecutorPool([StubExecutor(name=f"e{i}", latency=0.01)
+                         for i in range(4)])
+    boxes = {}
+
+    def run(tenant, weight):
+        boxes[tenant] = pool.run_tasks(
+            _tasks(240), max_inflight_per_executor=4,
+            payloads=_payloads(240), tenant=tenant, tenant_weight=weight)
+
+    heavy = threading.Thread(target=run, args=("heavy", 3.0))
+    light = threading.Thread(target=run, args=("light", 1.0))
+    heavy.start()
+    light.start()
+    # sample the split while BOTH tenants still have queued work
+    heavy.join(timeout=120)
+    at_heavy_finish = pool.load()["tenants"]
+    light.join(timeout=120)
+    assert all(r is not None for r in boxes["heavy"])
+    assert all(r is not None for r in boxes["light"])
+    h = at_heavy_finish["heavy"]["dispatched"]
+    l = at_heavy_finish["light"]["dispatched"]
+    assert h == 240
+    # ideal split at heavy's finish: light ran 1/3 of heavy's tasks (80);
+    # tolerance is generous — the contract is "tracks the ratio", not a
+    # cycle-exact scheduler
+    assert 0.15 <= l / h <= 0.55, f"weighted split off: heavy={h} light={l}"
+
+
+def test_tenant_load_reconciles_on_every_exit_path(monkeypatch):
+    """The satellite matrix: success, stage failure (abort contract),
+    speculation losers, and a mid-stage abrupt removal each reconcile the
+    per-tenant busy/demand maps to zero — no phantom per-tenant load."""
+    from raydp_tpu.runtime.rpc import RemoteError
+
+    def assert_clean(pool):
+        load = pool.load()
+        for tenant, row in load["tenants"].items():
+            assert row["busy"] == 0, (tenant, load)
+            assert row["demand"] == 0, (tenant, load)
+        with pool._lock:
+            assert pool._tenant_busy == {}, pool._tenant_busy
+            assert pool._tenant_demand == {}, pool._tenant_demand
+            assert pool._tenant_weight == {}, pool._tenant_weight
+            assert pool._parked_by_tenant == {}
+
+    # success path
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    pool = ExecutorPool([StubExecutor(name="a")])
+    pool.run_tasks(_tasks(4), payloads=_payloads(4), tenant="ok")
+    assert_clean(pool)
+
+    # stage failure -> abort contract (no-retry app error)
+    bad = StubExecutor(name="bad")
+    bad.script = [(0.01, lambda fut: fut.set_exception(
+        RemoteError("ValueError", "boom", "<tb>")))]
+    pool = ExecutorPool([bad])
+    with pytest.raises(Exception):
+        pool.run_tasks(_tasks(3), payloads=_payloads(3), tenant="aborts")
+    assert_clean(pool)
+
+    # speculation loser: the straggler's duplicate completes AFTER the
+    # stage returns; its busy decrement must still reconcile
+    monkeypatch.setenv("RDT_SPECULATION", "1")
+    monkeypatch.setenv("RDT_SPECULATION_QUANTILE", "0.5")
+    monkeypatch.setenv("RDT_SPECULATION_MULTIPLIER", "1.1")
+    monkeypatch.setenv("RDT_SPECULATION_MIN_S", "0.05")
+    slow = StubExecutor(name="slow", latency=0.8)
+    fast = StubExecutor(name="fast", latency=0.01)
+    pool = ExecutorPool([slow, fast])
+    out = pool.run_tasks(_tasks(6), max_inflight_per_executor=2,
+                         payloads=_payloads(6), tenant="spec")
+    assert all(r is not None for r in out)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with pool._lock:
+            if not pool._tenant_busy:
+                break
+        time.sleep(0.05)  # losers land asynchronously
+    assert_clean(pool)
+
+    # mid-stage drain + abrupt removal racing a running stage
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    a = StubExecutor(name="a", latency=0.05)
+    b = StubExecutor(name="b", latency=0.05)
+    pool = ExecutorPool([a, b])
+    box = {}
+
+    def run():
+        box["out"] = pool.run_tasks(_tasks(12), max_inflight_per_executor=2,
+                                    payloads=_payloads(12), tenant="drain")
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.05)
+    pool.begin_drain("a")
+    pool.remove_executor("a")
+    t.join(timeout=60)
+    assert all(r is not None for r in box["out"])
+    assert_clean(pool)
+
+
+def test_admission_parks_then_rejects_typed(monkeypatch):
+    """Over RDT_POOL_MAX_QUEUED the call parks (demand visible to the
+    autoscaler) and past RDT_ADMIT_TIMEOUT_S fails with the typed no-retry
+    AdmissionRejected — reconciling all load on the way out."""
+    from raydp_tpu.etl.engine import AdmissionRejected
+
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    monkeypatch.setenv("RDT_POOL_MAX_QUEUED", "10")
+    monkeypatch.setenv("RDT_ADMIT_TIMEOUT_S", "0.4")
+    metrics.reset()
+    pool = ExecutorPool([StubExecutor(name="e0", latency=0.05)])
+
+    def flood():
+        pool.run_tasks(_tasks(40), max_inflight_per_executor=2,
+                       payloads=_payloads(40), tenant="flood")
+
+    t = threading.Thread(target=flood)
+    t.start()
+    deadline = time.monotonic() + 5
+    while pool.load()["queued"] < 11 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    seen = {}
+
+    def late():
+        t0 = time.monotonic()
+        try:
+            pool.run_tasks(_tasks(4), payloads=_payloads(4), tenant="late")
+        except AdmissionRejected as e:
+            seen["err"] = e
+            seen["wall"] = time.monotonic() - t0
+
+    lt = threading.Thread(target=late)
+    lt.start()
+    time.sleep(0.1)
+    load = pool.load()
+    assert load["parked"] == 4, load  # parked demand is visible
+    assert load["queued"] >= 11      # ... and counted in the autoscale signal
+    # a PARKED tenant is not a fair-share contender: the running flood
+    # keeps its full in-flight cap instead of being serialized to one
+    # task for the whole park (which would also keep the backlog from
+    # ever draining)
+    assert load["tenants"]["flood"]["busy"] == 2, load
+    assert pool._fair_ok("flood")
+    lt.join(timeout=30)
+    t.join(timeout=60)
+    assert isinstance(seen.get("err"), AdmissionRejected), seen
+    assert seen["wall"] >= 0.35
+    assert_events = [e["kind"] for e in metrics.events()]
+    assert "admission_reject" in assert_events
+    snap = metrics.snapshot()["counters"]
+    assert snap["pool_admission_parked_total"] == {"late": 1}
+    assert snap["pool_admission_rejects_total"] == {"late": 1}
+    with pool._lock:
+        assert pool._parked_by_tenant == {}
+        assert pool._tenant_demand == {}
+
+
+def test_admission_empty_backlog_always_admits(monkeypatch):
+    """A single action larger than the bound runs on an idle pool — the
+    bound protects against a backlog, it never wedges a lone big stage."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    monkeypatch.setenv("RDT_POOL_MAX_QUEUED", "5")
+    monkeypatch.setenv("RDT_ADMIT_TIMEOUT_S", "0.2")
+    pool = ExecutorPool([StubExecutor(name="e0")])
+    out = pool.run_tasks(_tasks(30), payloads=_payloads(30), tenant="big")
+    assert all(r is not None for r in out)
+
+
+def test_admission_parked_action_admitted_when_backlog_drains(monkeypatch):
+    """The park is a wait, not a rejection: once the running backlog
+    drains under the bound the parked action dispatches and completes."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    monkeypatch.setenv("RDT_POOL_MAX_QUEUED", "10")
+    monkeypatch.setenv("RDT_ADMIT_TIMEOUT_S", "30")
+    pool = ExecutorPool([StubExecutor(name="e0", latency=0.01)])
+
+    def flood():
+        pool.run_tasks(_tasks(30), max_inflight_per_executor=2,
+                       payloads=_payloads(30), tenant="flood")
+
+    t = threading.Thread(target=flood)
+    t.start()
+    deadline = time.monotonic() + 5
+    while pool.load()["queued"] < 11 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    out = pool.run_tasks(_tasks(4), payloads=_payloads(4), tenant="late")
+    t.join(timeout=60)
+    assert all(r is not None for r in out)
+
+
+def test_backpressure_pauses_and_resumes_dispatch(monkeypatch):
+    """A host above the store high-watermark takes no dispatch until it
+    drops below the low-watermark; with every host paused, tasks wait
+    (graceful degradation) and complete once pressure lifts."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    metrics.reset()
+    pressure = {"hostA": 2.0}
+    a = StubExecutor(name="a")
+    b = StubExecutor(name="b")
+    pool = ExecutorPool([a, b], hosts_by_name={"a": "hostA", "b": "hostB"})
+    pool.pressure_provider = lambda: dict(pressure)
+    out = pool.run_tasks(_tasks(6), payloads=_payloads(6))
+    assert all(r is not None for r in out)
+    assert len(a.submits) == 0, "dispatched to a backpressured host"
+    assert len(b.submits) == 6
+    assert pool.load()["backpressured_hosts"] == ["hostA"]
+
+    # every host over the watermark: dispatch pauses, then resumes when
+    # pressure drops (the cache TTL is 0.5s; drop it via a fresh eval)
+    pressure["hostB"] = 2.0
+    pool._pressure_cache = None
+    box = {}
+
+    def run():
+        box["out"] = pool.run_tasks(_tasks(2), payloads=_payloads(2))
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.3)
+    assert "out" not in box, "dispatch proceeded under full backpressure"
+    pressure.update({"hostA": 0.5, "hostB": 0.5})
+    pool._pressure_cache = None
+    t.join(timeout=30)
+    assert all(r is not None for r in box["out"])
+    kinds = [e["kind"] for e in metrics.events()]
+    assert "backpressure" in kinds
+    snap = metrics.snapshot()["counters"]
+    assert snap["pool_backpressure_total"]["hostA"] >= 1
+
+
+def test_backpressure_fails_closed_on_stats_error(monkeypatch):
+    """A transient pressure-provider failure (an overloaded store head is
+    exactly when stats() times out) must KEEP the previous pause state,
+    never fail open and resume dispatch to an over-watermark host."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    pressure = {"hostA": 2.0}
+    a = StubExecutor(name="a")
+    b = StubExecutor(name="b")
+    pool = ExecutorPool([a, b], hosts_by_name={"a": "hostA", "b": "hostB"})
+    calls = {"n": 0}
+
+    def provider():
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise RuntimeError("stats timed out")
+        return dict(pressure)
+
+    pool.pressure_provider = provider
+    assert pool.load()["backpressured_hosts"] == ["hostA"]  # tripped
+    pool._pressure_cache = None  # force a re-evaluation: provider now fails
+    out = pool.run_tasks(_tasks(4), payloads=_payloads(4))
+    assert all(r is not None for r in out)
+    assert len(a.submits) == 0, "stats failure fail-opened backpressure"
+    assert pool.load()["backpressured_hosts"] == ["hostA"]
+    assert calls["n"] >= 2

@@ -600,3 +600,97 @@ def test_export_requires_fit(tmp_path):
     with pytest.raises(NotImplementedError):
         GBDTEstimator(feature_columns=["a"],
                       label_column="b").export_serving(str(tmp_path / "y"))
+
+
+# ---------------------------------------------------------------------------
+# overload shedding (ISSUE 14): typed rejections, dispatcher stays alive
+# ---------------------------------------------------------------------------
+
+def test_overload_sheds_typed_and_dispatcher_survives(monkeypatch):
+    """Past RDT_SERVE_MAX_QUEUE outstanding requests predict_async fails
+    fast with the typed retriable ServingOverloaded; accepted requests
+    keep serving byte-correct results, the report shows failed == shed,
+    and — the retriable contract — the session accepts again once the
+    queue drains."""
+    from raydp_tpu.serve import ServingOverloaded
+
+    monkeypatch.setenv("RDT_SERVE_MAX_QUEUE", "4")
+    slow = FakeReplicaHandle("a", delay_s=0.25)
+    srv = _serving([slow], monkeypatch, max_batch=1, timeout_ms=0.0,
+                   inflight=1)
+    try:
+        futs, sheds = [], 0
+        for i in range(12):
+            try:
+                futs.append((i, srv.predict_async(_rows(float(i)))))
+            except ServingOverloaded as e:
+                assert isinstance(e, ServingError)  # subclass: one catch
+                sheds += 1
+        assert sheds >= 1, "queue bound never shed"
+        assert len(futs) >= 4  # the bound's worth was accepted
+        for i, f in futs:
+            got = f.result(timeout=30.0)
+            assert got[0] == np.float32(2.0 * i)  # accepted = byte-correct
+        rep = srv.serving_report()
+        assert rep["shed"] == sheds
+        assert rep["failed"] == rep["shed"], rep  # failed == shed ONLY
+        assert rep["outstanding"] == 0
+        assert rep["max_queue"] == 4
+        # retriable: the drained session accepts and serves again
+        assert srv.predict(_rows(99.0), timeout=30.0)[0] \
+            == np.float32(198.0)
+    finally:
+        srv.close()
+
+
+def test_overload_shed_disabled_by_zero(monkeypatch):
+    monkeypatch.setenv("RDT_SERVE_MAX_QUEUE", "0")
+    srv = _serving([FakeReplicaHandle("a", delay_s=0.05)], monkeypatch,
+                   max_batch=1, timeout_ms=0.0, inflight=1)
+    try:
+        futs = [srv.predict_async(_rows(float(i))) for i in range(32)]
+        for i, f in enumerate(futs):
+            assert f.result(timeout=30.0)[0] == np.float32(2.0 * i)
+        rep = srv.serving_report()
+        assert rep["shed"] == 0 and rep["failed"] == 0
+    finally:
+        srv.close()
+
+
+def test_hedging_suppressed_while_shedding(monkeypatch):
+    """A saturated session must not hedge: the duplicate dispatch would
+    amplify the very overload being shed. The same straggler that hedges
+    under an uncontended queue rides out its full delay when the
+    outstanding queue sits at the bound."""
+    for max_queue, expect_hedge in (("100", True), ("1", False)):
+        monkeypatch.setenv("RDT_SERVE_MAX_QUEUE", max_queue)
+        slow_after = {"n": 0}
+
+        def a_delay():
+            slow_after["n"] += 1
+            return 0.0 if slow_after["n"] <= 8 else 1.0
+
+        fakes = [FakeReplicaHandle("a", delay_s=a_delay),
+                 FakeReplicaHandle("b", delay_s=0.0)]
+        srv = _serving(fakes, monkeypatch, max_batch=1, timeout_ms=0.0,
+                       hedge=True, hedge_mult=2.0, hedge_min_ms=50.0)
+        try:
+            for i in range(16):  # warmup: record the fast latency floor
+                srv.predict(_rows(float(i)), timeout=30.0)
+            # one straggler dispatch; with max_queue=1 the lone
+            # outstanding request saturates the session
+            t0 = time.monotonic()
+            while True:  # land a request on the (now slow) replica a
+                got = srv.predict(_rows(123.0), timeout=30.0)
+                if slow_after["n"] > 9:
+                    break
+            wall = time.monotonic() - t0
+            assert got[0] == np.float32(246.0)
+            rep = srv.serving_report()
+            if expect_hedge:
+                assert rep["hedged"] >= 1, (max_queue, rep)
+            else:
+                assert rep["hedged"] == 0, (max_queue, rep)
+                assert wall >= 0.9, "suppressed hedge still cut the tail?"
+        finally:
+            srv.close()
