@@ -20,6 +20,15 @@ Three configs, each a fresh session:
    the end must carry the drain/recovery evidence chain
    (``executor_drain`` → ``executor_down`` → ``recovery_round``).
 
+4. ``outofcore`` (``--outofcore``; records ``benchmarks/SPILL.json``) —
+   the ROADMAP item 4c headroom proof: a full-row sort shuffle moving
+   several× the store's configured shm budget, so the sealed input and map
+   blobs MUST spill to disk mid-action and fault back in transparently.
+   Asserted: the spill really engaged (``spilled_objects > 0``, measured
+   peak bytes a recorded multiple of the budget), the result is
+   byte-identical to the same action under a roomy budget, zero failed
+   actions, zero orphans.
+
 3. ``fairness`` (``--fairness``; the ``chaos-overload`` CI leg) — the
    multi-tenant overload contract on one fixed 2-executor pool under a
    seeded per-map delay: a FLOODING tenant (a second ``Engine`` over the
@@ -389,6 +398,99 @@ def run_fairness_config(smoke):
     return record
 
 
+def run_outofcore_config(smoke):
+    """Config 4: sort-shuffle several× the store budget — spill engages,
+    results stay byte-identical, nothing fails, nothing orphans."""
+    import pandas as _pd
+
+    import raydp_tpu
+    from raydp_tpu import config as cfg
+    from raydp_tpu.runtime.object_store import get_client
+
+    rows = 60_000 if smoke else 240_000
+    budget = (2 << 20) if smoke else (8 << 20)
+    rng = np.random.RandomState(0)
+    pdf = _pd.DataFrame({
+        "k": rng.randint(0, 1_000_000, rows),
+        "v": rng.randint(0, 1000, rows).astype(np.int64),
+        # a fat payload column so the sort shuffle moves real bytes —
+        # ~128 B/row of string data dominates the row's footprint
+        "payload": ["x" * 96 + f"{i:032d}" for i in range(rows)],
+    })
+
+    def one_run(shm_budget):
+        configs = None
+        if shm_budget:
+            configs = {cfg.OBJECT_STORE_MEMORY_KEY: str(shm_budget),
+                       cfg.SPILL_BUDGET_KEY: str(shm_budget)}
+            # this config DELIBERATELY oversubscribes the store — disk
+            # spill is the mechanism under test, so the PR 14 memory
+            # backpressure (which would pause dispatch at 1.25× budget and
+            # deadlock an action whose own inputs hold the memory) steps
+            # aside for the run
+            os.environ["RDT_STORE_HIGH_WATERMARK"] = "1e9"
+        s = raydp_tpu.init("spill-bench", num_executors=2, executor_cores=1,
+                           executor_memory="512MB", configs=configs)
+        try:
+            client = get_client()
+            df = s.createDataFrame(pdf, num_partitions=8)
+            # the audit baseline includes the live input frame (its blocks
+            # belong to df for the whole run); the ACTION must add nothing
+            before = client.stats()["num_objects"]
+            t0 = time.time()
+            out = s.engine.collect(df.sort("k")._plan)
+            wall = time.time() - t0
+            stats = client.stats()
+            peak = {
+                "spilled_objects": stats.get("spilled_objects", 0),
+                "spilled_bytes": stats.get("spilled_bytes", 0),
+                "shm_bytes": stats.get("shm_bytes", 0),
+            }
+            data = _ipc_bytes(out)
+            deadline = time.time() + 30
+            while time.time() < deadline \
+                    and client.stats()["num_objects"] != before:
+                time.sleep(0.25)
+            orphans = client.stats()["num_objects"] - before
+            return data, wall, peak, orphans
+        finally:
+            raydp_tpu.stop()
+            os.environ.pop("RDT_STORE_HIGH_WATERMARK", None)
+
+    base, base_wall, _, orphans0 = one_run(None)  # roomy default budget
+    got, wall, peak, orphans1 = one_run(budget)
+    moved = peak["spilled_bytes"] + peak["shm_bytes"]
+    record = {
+        "rows": rows,
+        "budget_bytes": budget,
+        "result_bytes": len(base),
+        "byte_identical": base == got,
+        "spilled_objects": peak["spilled_objects"],
+        "spilled_bytes": peak["spilled_bytes"],
+        "store_bytes_over_budget": round(moved / budget, 2),
+        "spill_engaged": peak["spilled_objects"] > 0,
+        "wall_s": round(wall, 2),
+        "incore_wall_s": round(base_wall, 2),
+        "failed_actions": 0,  # one_run raises (and the bench fails) on any
+        "orphans_incore": orphans0,
+        "orphans_spill": orphans1,
+    }
+    print(f"[outofcore] spilled={record['spilled_objects']} objs "
+          f"({record['store_bytes_over_budget']}x budget) "
+          f"identical={record['byte_identical']} "
+          f"wall={record['wall_s']}s (incore {record['incore_wall_s']}s) "
+          f"orphans={record['orphans_spill']}")
+    return record
+
+
+def _assert_outofcore(rec):
+    assert rec["byte_identical"], rec
+    assert rec["spill_engaged"], rec
+    assert rec["store_bytes_over_budget"] >= 2.0, rec
+    assert rec["failed_actions"] == 0, rec
+    assert rec["orphans_incore"] == 0 and rec["orphans_spill"] == 0, rec
+
+
 def _assert_fairness(fair):
     assert fair["interactive_failed"] == 0, fair
     assert fair["results_identical"], fair
@@ -408,9 +510,31 @@ def main():
     ap.add_argument("--fairness", action="store_true",
                     help="run ONLY the multi-tenant fairness config "
                          "(records benchmarks/FAIR.json)")
+    ap.add_argument("--outofcore", action="store_true",
+                    help="run ONLY the out-of-core headroom config "
+                         "(records benchmarks/SPILL.json)")
     ap.add_argument("--out", default=None, help="record path override")
     args = ap.parse_args()
     here = os.path.dirname(os.path.abspath(__file__))
+    if args.outofcore:
+        out = args.out or ("/tmp/SPILL_SMOKE.json" if args.smoke
+                           else os.path.join(here, "SPILL.json"))
+        ooc = run_outofcore_config(args.smoke)
+        record = {
+            "bench": "scale_bench",
+            # headline + PERF_CLAIMS handle (tests/test_perf_claims)
+            "metric": "outofcore_store_bytes_over_budget",
+            "value": ooc["store_bytes_over_budget"],
+            "smoke": args.smoke,
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "configs": {"outofcore": ooc},
+        }
+        with open(out, "w") as fh:
+            json.dump(record, fh, indent=2, sort_keys=True)
+        print(f"record written to {out}")
+        _assert_outofcore(record["configs"]["outofcore"])
+        print("outofcore bench contract: OK")
+        return
     if args.fairness:
         out = args.out or ("/tmp/FAIR_SMOKE.json" if args.smoke
                            else os.path.join(here, "FAIR.json"))

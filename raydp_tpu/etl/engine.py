@@ -494,6 +494,11 @@ class ExecutorPool:
         #: cannot take the slot the gate would reserve for it — counting it
         #: would serialize every running tenant for the whole park)
         self._parked_by_tenant: Dict[str, int] = {}  # guarded-by: _lock
+        #: FIFO of parked admissions (monotonic tickets, append order): a
+        #: freed backlog admits the LONGEST-parked action first instead of
+        #: whichever poll loop woke up luckiest (ROADMAP 3c)
+        self._park_queue: List[int] = []  # guarded-by: _lock
+        self._park_seq = 0  # guarded-by: _lock
         # ---- memory backpressure: hosts paused above the store
         # high-watermark (hysteresis: released below the low-watermark).
         # The cache tuple (expiry, frozenset) is swapped atomically and
@@ -809,6 +814,9 @@ class ExecutorPool:
         autoscaler sees the parked work and can grow to absorb it (busy
         capacity up → backlog down → admitted). An empty backlog always
         admits — a single action larger than the bound must run, not wedge.
+        Admission is FIFO in park order: freed backlog goes to the
+        longest-parked action first, and a fresh arrival queues BEHIND
+        already-parked actions instead of racing them for the slot.
         Past ``RDT_ADMIT_TIMEOUT_S`` the call fails with the typed no-retry
         :class:`AdmissionRejected`."""
         max_q = int(knobs.get("RDT_POOL_MAX_QUEUED"))
@@ -817,6 +825,7 @@ class ExecutorPool:
         timeout = float(knobs.get("RDT_ADMIT_TIMEOUT_S"))
         deadline = time.monotonic() + max(0.0, timeout)
         parked = False
+        ticket: Optional[int] = None
         try:
             while True:
                 newly_parked = False
@@ -827,13 +836,23 @@ class ExecutorPool:
                         0, self._demand
                         - sum(self._parked_by_tenant.values())
                         - own - busy_total)
-                    if backlog <= 0 or backlog + n <= max_q:
+                    fits = backlog <= 0 or backlog + n <= max_q
+                    # FIFO gate: freed backlog belongs to the queue head;
+                    # an unparked newcomer counts as head only while nobody
+                    # is parked at all (first parked, first admitted)
+                    head = (self._park_queue[0] == ticket if parked
+                            else not self._park_queue)
+                    if fits and head:
                         if parked:
                             self._bump(self._parked_by_tenant, tenant, -n)
+                            self._park_queue.remove(ticket)
                             parked = False
                         return
                     if not parked:
                         parked = newly_parked = True
+                        ticket = self._park_seq
+                        self._park_seq += 1
+                        self._park_queue.append(ticket)
                         self._bump(self._parked_by_tenant, tenant, n)
                 if newly_parked:
                     metrics.inc("pool_admission_parked_total", label=tenant)
@@ -856,6 +875,8 @@ class ExecutorPool:
             if parked:
                 with self._lock:
                     self._bump(self._parked_by_tenant, tenant, -n)
+                    if ticket in self._park_queue:
+                        self._park_queue.remove(ticket)
 
     # ---- memory backpressure ------------------------------------------------
     @staticmethod

@@ -21,7 +21,7 @@ Spec grammar (documented in doc/fault_tolerance.md)::
 
     sites   : executor.run_task | shuffle.write | shuffle.fetch | store.get
               | store.spill | rpc.call | estimator.epoch | serve.predict
-              | pool.drain | pool.scale
+              | pool.drain | pool.scale | stream.epoch
               (env specs must name a KNOWN_SITES entry)
     actions : crash | delay | raise | drop | connloss   (interpreted by the site)
     keys    : nth= every= p= times= seed= match= once= ms= ms_per_mb= bucket=
@@ -78,6 +78,7 @@ KNOWN_SITES = frozenset((
     "serve.predict",
     "pool.drain",
     "pool.scale",
+    "stream.epoch",
 ))
 
 #: the site-specific actions and the only call sites that interpret them —
@@ -85,7 +86,8 @@ KNOWN_SITES = frozenset((
 #: a drop armed at rpc.call would claim its sentinel and inject nothing,
 #: the same silent-no-op the action-name check exists to prevent
 SITE_SPECIFIC_ACTIONS = {
-    "drop": ("shuffle.write", "store.get", "shuffle.fetch", "store.spill"),
+    "drop": ("shuffle.write", "store.get", "shuffle.fetch", "store.spill",
+             "stream.epoch"),
     "connloss": ("rpc.call",),
 }
 

@@ -58,8 +58,8 @@ class Knob:
     type: str          # "bool" | "int" | "float" | "str"
     default: object    # typed default; None = unset (or computed at the site)
     scope: str         # PER_ACTION | PROCESS_START
-    category: str      # "etl" | "training" | "serving" | "runtime"
-                       # | "faults" | "spmd"
+    category: str      # "etl" | "training" | "serving" | "stream"
+                       # | "runtime" | "faults" | "spmd"
     doc: str           # one-line description for the generated doc tables
     #: framework-injected IPC value (set by the head/agent/submit wrapper for
     #: child processes), not a user-facing tuning knob
@@ -231,6 +231,31 @@ _ALL = [
        "ServingOverloaded instead of growing the dispatcher queue, and "
        "hedging is suppressed while saturated. 0 disables shedding. Read "
        "at serving-session construction."),
+    _k("RDT_SERVE_SWAP_DRAIN_S", "float", 30.0, PER_ACTION, "serving",
+       "How long a hot-swap's background retirement waits for the OLD "
+       "servable's in-flight dispatches to drain before unloading it "
+       "anyway (in-flight requests on it still complete; the registry "
+       "entry just goes away)."),
+    # ---- continuous pipelines -----------------------------------------------
+    _k("RDT_STREAM_RETAIN", "int", 64, PER_ACTION, "stream",
+       "Epochs of replay state a continuous pipeline keeps: the source "
+       "journal and the published epoch blobs of the newest N epochs stay "
+       "available for exactly-once replay / late ranged-fetch; older "
+       "epochs are freed as the stream advances."),
+    _k("RDT_STREAM_REPLAY_ROUNDS", "int", 4, PER_ACTION, "stream",
+       "Replay rounds a window merge (or epoch-stream fetch) attempts when "
+       "an epoch blob is lost (ObjectLostError): each round re-derives the "
+       "lost epochs from the source journal and re-seals them."),
+    _k("RDT_STREAM_POLL_TIMEOUT_S", "float", 10.0, PER_ACTION, "stream",
+       "Longest a pipeline step blocks on its source before re-checking "
+       "for stop/close (idle tick; the source may return rows sooner)."),
+    _k("RDT_STREAM_EXPORT_EVERY", "int", 0, PER_ACTION, "stream",
+       "Default epochs between partial_fit servable exports (and hot-swaps "
+       "when a serving session is attached). 0 disables the cadence; the "
+       "partial_fit export_every= argument overrides."),
+    _k("RDT_STREAM_MAX_PARTITIONS", "int", 0, PER_ACTION, "stream",
+       "Partitions each micro-batch epoch is split into before its engine "
+       "action (0 = auto: min(executors, rows))."),
     # ---- runtime ------------------------------------------------------------
     _k("RDT_LOG_LEVEL", "str", "INFO", PROCESS_START, "runtime",
        "Log level of spawned processes (node agents, SPMD rank workers)."),
@@ -358,6 +383,7 @@ DOC_TABLES = (
     ("doc/etl.md", "etl"),
     ("doc/training.md", "training"),
     ("doc/serving.md", "serving"),
+    ("doc/streaming.md", "stream"),
     ("doc/dev_lint.md", None),
 )
 

@@ -192,6 +192,24 @@ _ALL_METRICS = [
        "Rows per dispatched micro-batch (coalescing effectiveness)."),
     _m("serve_request_seconds", HISTOGRAM, "s", "serving",
        "Per-request latency from enqueue to demuxed completion."),
+    _m("serve_hot_swaps_total", COUNTER, "1", "serving",
+       "Servable hot-swaps completed by a serving session (new version "
+       "loaded beside the old, traffic shifted, old retired)."),
+    # ---- continuous pipelines -----------------------------------------------
+    _m("stream_epochs_total", COUNTER, "1", "stream",
+       "Micro-batch epochs a continuous pipeline completed (transform ran, "
+       "result sealed + published to the epoch ledger)."),
+    _m("stream_rows_total", COUNTER, "rows", "stream",
+       "Input rows ingested across all continuous-pipeline epochs."),
+    _m("stream_epoch_seconds", HISTOGRAM, "s", "stream",
+       "Wall-clock of one micro-batch epoch (source rows in hand to sealed "
+       "+ published result)."),
+    _m("stream_windows_total", COUNTER, "1", "stream",
+       "Windowed aggregations closed (tumbling/sliding merges over epoch "
+       "partials)."),
+    _m("stream_replays_total", COUNTER, "1", "stream",
+       "Lost epoch blobs re-derived from the source journal "
+       "(exactly-once replay rounds; each replayed epoch counts once)."),
     # ---- data feed / training -----------------------------------------------
     _m("feed_phase_seconds", HISTOGRAM, "s", "feed",
        "Feed-pipeline phase walls (decode / stage / h2d), one observation "
@@ -252,6 +270,13 @@ _ALL_SPANS = [
        "The duplicate dispatch of a hedged micro-batch."),
     _s("serve:apply", "serving",
        "The replica-side jitted apply of one micro-batch."),
+    # ---- continuous pipelines -----------------------------------------------
+    _s("stream:epoch", "stream",
+       "One micro-batch epoch of a continuous pipeline: ingest, transform "
+       "action, seal + ledger publish, window partials."),
+    _s("stream:window", "stream",
+       "One windowed-aggregation merge over the epoch partials of a "
+       "closing window (including any replay rounds)."),
 ]
 
 SPANS: Dict[str, Span] = {s.name: s for s in _ALL_SPANS}
@@ -315,6 +340,12 @@ _ALL_EVENTS = [
     _e("overload_shed", "serving",
        "A serving request was refused at admission (ServingOverloaded) "
        "because the session's outstanding queue was at its bound."),
+    _e("hot_swap", "serving",
+       "A serving session atomically shifted traffic to a freshly loaded "
+       "servable version (the old one retires in the background)."),
+    _e("stream_replay", "stream",
+       "A continuous pipeline re-derived a lost epoch blob from its "
+       "source journal (exactly-once replay; epoch + reason recorded)."),
 ]
 
 EVENTS: Dict[str, Event] = {e.kind: e for e in _ALL_EVENTS}

@@ -254,6 +254,11 @@ class ServingSession:
         self._adm_lock = threading.Lock()
         self._outstanding = 0  # guarded-by: _adm_lock
         self._shed_count = 0   # guarded-by: _adm_lock
+        #: serializes hot_swap() callers (the swap itself applies on the
+        #: dispatcher thread; this only orders concurrent swap requests)
+        self._swap_lock = threading.Lock()
+        self._swap_drain_s = max(
+            0.0, float(knobs.get("RDT_SERVE_SWAP_DRAIN_S")))
 
         self._replicas: List[_ReplicaState] = []
         loads = []
@@ -276,6 +281,14 @@ class ServingSession:
         self._parked: List[_Dispatch] = []     # waiting for a replica
         self._rr = itertools.count()
         self._did = itertools.count()
+        # servable-version state (dispatcher-owned after construction; the
+        # active version answers every new dispatch, retiring versions only
+        # finish what they already hold)
+        self._version = 1
+        self._active_tag: Optional[str] = None
+        self._swaps = 0
+        #: (drain deadline, replicas, version) of swapped-out servables
+        self._retiring: List = []
         self._closed = False
         self._batch_lat: List[float] = []      # bounded; hedge quantile base
         self._req_lat: List[float] = []        # bounded; report p50/p99
@@ -357,6 +370,57 @@ class ServingSession:
             return self._max_queue > 0 \
                 and self._outstanding >= self._max_queue
 
+    def hot_swap(self, export_dir: str, tag: Optional[str] = None,
+                 timeout: float = 180.0) -> Dict[str, Any]:
+        """Atomically roll the session onto a new servable under live
+        traffic: load the bundle at ``export_dir`` BESIDE the active one on
+        every replica's executor (distinct replica ids — the registry holds
+        both), shift all new dispatches to it in one dispatcher step, and
+        retire the old version in the background once its in-flight work
+        drains (bounded by ``RDT_SERVE_SWAP_DRAIN_S``; stragglers still
+        complete, the registry entry just goes away). No request is dropped:
+        every response comes from exactly one version — the one its
+        dispatch was routed to. ``tag`` annotates the version in
+        :meth:`serving_report` (``partial_fit`` passes the source epoch).
+        Thread-safe; concurrent swaps serialize in call order."""
+        if self._closed:
+            raise ServingError("serving session is closed")
+        with self._swap_lock:
+            # replica handles/executors are dispatcher-owned state (reloads
+            # re-bind them): snapshot them ON the dispatcher thread instead
+            # of racing _maybe_rebind from here
+            snap: Future = Future()
+            self._events.put(("swap_prep", snap))
+            members = snap.result(timeout=30.0)
+            v = self._version + 1
+            new_reps: List[_ReplicaState] = []
+            loads = []
+            for i, (handle, executor) in enumerate(members):
+                rid = f"{self.name}-v{v}-r{i}"
+                rep = _ReplicaState(rid, handle, executor)
+                # parallel load beside the active servable — the old rid
+                # keeps serving while the new one pays its jit
+                replica = rep.replica
+                loads.append(replica.submit("serve_load", rid, export_dir))
+                new_reps.append(rep)
+            errors = []
+            for f in loads:
+                try:
+                    f.result(timeout=timeout)
+                except Exception as e:  # noqa: BLE001 - collected below
+                    errors.append(e)
+            if errors:
+                # never leave a half-loaded version pinning executor RAM:
+                # unload whatever DID land, then surface the failure
+                self._unload_replicas(new_reps, v)
+                raise ServingError(
+                    f"hot swap to {export_dir!r} failed loading "
+                    f"{len(errors)}/{len(loads)} replica(s); the partial "
+                    f"load was rolled back") from errors[0]
+            done: Future = Future()
+            self._events.put(("swap", new_reps, export_dir, v, tag, done))
+            return done.result(timeout=30.0)
+
     def serving_report(self) -> Dict[str, Any]:
         """Counters + latency snapshot (the ``shuffle_stage_report`` twin
         for the serving plane; columns documented in doc/serving.md)."""
@@ -375,7 +439,13 @@ class ServingSession:
         self._events.put(("stop",))
         self._dispatcher.join(timeout=30.0)
         if unload:
-            for rep in self._replicas:
+            # the active replicas plus any swapped-out version still
+            # draining (the dispatcher is down: nothing retires them now)
+            doomed = list(self._replicas)
+            for _, reps, _ in self._retiring:
+                doomed.extend(reps)
+            self._retiring = []
+            for rep in doomed:
                 try:
                     rep.replica.call("serve_unload", rep.rid, timeout=10.0)
                 except Exception:  # noqa: BLE001 - executor may be gone
@@ -407,11 +477,19 @@ class ServingSession:
                         self._on_done(ev[1], ev[2], ev[3], ev[4])
                     elif kind == "replica_up":
                         self._on_replica_up(ev[1], ev[2])
+                    elif kind == "swap_prep":
+                        # a torn mid-rebind (handle, name) pair is what the
+                        # dispatcher-thread copy exists to prevent
+                        ev[1].set_result([(r.replica, r.executor)
+                                          for r in self._replicas])
+                    elif kind == "swap":
+                        self._on_swap(ev[1], ev[2], ev[3], ev[4], ev[5])
                     elif kind == "report":
                         ev[1].set_result(self._report())
                 self._flush_batches()
                 self._maybe_hedge()
                 self._retry_parked()
+                self._retire_swapped()
                 # refresh on every loop pass (arrivals, flushes, drains
                 # alike) so an idle session reads 0, not the last
                 # pre-dispatch depth; labeled per session so two sessions
@@ -438,6 +516,8 @@ class ServingSession:
                 if not d.hedged and not d.done:
                     deadlines.append(d.t_first + hedge_after)
         if self._parked:
+            deadlines.append(time.monotonic() + 0.05)
+        if self._retiring:
             deadlines.append(time.monotonic() + 0.05)
         if not deadlines:
             return None
@@ -821,6 +901,60 @@ class ServingSession:
                                  executor=rep.executor)
             logger.info("replica %s reloaded and back in rotation", rep.rid)
 
+    # -- hot swap (dispatcher side) -------------------------------------------
+    def _on_swap(self, new_reps: List[_ReplicaState], export_dir: str,
+                 version: int, tag: Optional[str], done: Future) -> None:
+        """The atomic half of :meth:`hot_swap`: one dispatcher step swaps
+        the routing table, so a dispatch either chose the old version or
+        the new one — never a mix, never a gap."""
+        old = self._replicas
+        self._replicas = new_reps
+        self.export_dir = export_dir
+        self._version = version
+        self._active_tag = tag
+        self._swaps += 1
+        self._retiring.append(
+            (time.monotonic() + self._swap_drain_s, old, version - 1))
+        metrics.inc("serve_hot_swaps_total")
+        metrics.record_event("hot_swap", session=self.name, version=version,
+                             export_dir=export_dir, tag=tag or "")
+        logger.info("serving session %s hot-swapped to v%d (%s%s); v%d "
+                    "retiring behind %d in-flight dispatch(es)", self.name,
+                    version, export_dir, f", tag={tag}" if tag else "",
+                    version - 1, sum(r.inflight for r in old))
+        done.set_result({"version": version, "export_dir": export_dir,
+                         "tag": tag,
+                         "replicas": [r.rid for r in new_reps]})
+
+    def _retire_swapped(self) -> None:
+        """Unload swapped-out versions once their in-flight dispatches
+        drained (or the ``RDT_SERVE_SWAP_DRAIN_S`` deadline passed — the
+        straggler requests still complete; only the registry entry goes)."""
+        if not self._retiring:
+            return
+        keep = []
+        for deadline, reps, ver in self._retiring:
+            if all(r.inflight <= 0 for r in reps) \
+                    or time.monotonic() >= deadline:
+                # the unloads are RPCs with their own timeouts: background
+                # thread, never the dispatcher loop
+                threading.Thread(
+                    target=self._unload_replicas, args=(reps, ver),
+                    daemon=True,
+                    name=f"rdt-serve-retire-{self.name}-v{ver}").start()
+            else:
+                keep.append((deadline, reps, ver))
+        self._retiring = keep
+
+    def _unload_replicas(self, reps: List[_ReplicaState], ver: int) -> None:
+        for rep in reps:
+            try:
+                rep.replica.call("serve_unload", rep.rid, timeout=10.0)
+            except Exception:  # noqa: BLE001 - executor may be gone
+                pass
+        logger.info("serving session %s retired servable v%d "
+                    "(%d replica(s) unloaded)", self.name, ver, len(reps))
+
     # -- hedging --------------------------------------------------------------
     def _hedge_deadline(self) -> Optional[float]:
         """Seconds after which an in-flight dispatch earns a hedge, or None
@@ -868,6 +1002,15 @@ class ServingSession:
         out["shed"] = shed
         out["failed"] = out["failed"] + shed
         out.update({
+            # which model answers right now: the active servable's version,
+            # bundle dir, and the tag the swapper attached (partial_fit's
+            # source epoch) — what the bench/chaos legs assert on
+            "servable": {"version": self._version,
+                         "export_dir": self.export_dir,
+                         "tag": self._active_tag},
+            "hot_swaps": self._swaps,
+            "retiring_replicas": sum(len(reps)
+                                     for _, reps, _ in self._retiring),
             "outstanding": outstanding,
             "max_queue": self._max_queue,
             "p50_ms": round(_quantile(lat, 0.50) * 1000.0, 3),
@@ -918,5 +1061,20 @@ class ServingSession:
                 if not ev[1].fut.done():
                     ev[1].fut.set_exception(err)
                 ev[1].finish(failed=True)
+            elif ev[0] == "swap_prep":
+                if not ev[1].done():
+                    ev[1].set_exception(
+                        ServingError("serving session closed mid-swap"))
+            elif ev[0] == "swap":
+                # the new version DID load on the replicas: unload it (in
+                # the background — these are RPCs) instead of leaving its
+                # weights pinned in executor RAM forever
+                threading.Thread(
+                    target=self._unload_replicas, args=(ev[1], ev[3]),
+                    daemon=True,
+                    name=f"rdt-serve-drainswap-{self.name}").start()
+                if not ev[5].done():
+                    ev[5].set_exception(
+                        ServingError("serving session closed mid-swap"))
             elif ev[0] == "report":
                 ev[1].set_result(self._report())

@@ -1,0 +1,40 @@
+"""Continuous pipelines: streaming ingest → incremental shuffle epochs →
+windowed aggregation → online training → serving hot-swap (doc/streaming.md).
+
+    from raydp_tpu import stream
+    pipe = stream.read_stream(stream.FileTailSource("/landing")) \
+               .transform(lambda df: df.filter(...)) \
+               .window(size=4, keys=["k"], aggs={"v": ["sum", "mean"]})
+    for epoch in pipe.epochs():
+        ...
+"""
+
+from raydp_tpu.stream.pipeline import (
+    ContinuousPipeline,
+    EpochResult,
+    EpochStream,
+    WindowResult,
+    read_stream,
+)
+from raydp_tpu.stream.sources import (
+    FileTailSource,
+    MicroBatch,
+    ReplayLogSource,
+    StreamError,
+    StreamSource,
+    SyntheticSource,
+)
+
+__all__ = [
+    "ContinuousPipeline",
+    "EpochResult",
+    "EpochStream",
+    "FileTailSource",
+    "MicroBatch",
+    "ReplayLogSource",
+    "StreamError",
+    "StreamSource",
+    "SyntheticSource",
+    "WindowResult",
+    "read_stream",
+]

@@ -619,6 +619,149 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
         return state, history
 
+    # ------------------------------------------------------------ partial_fit
+    def _partial_fit_epoch(self, ds, epoch: int) -> Dict[str, float]:
+        """One online update: a single gradient pass over the epoch's rows
+        through the streaming ``DeviceFeed`` (decode/stage/H2D prefetch
+        overlap the jitted steps, as in ``fit``). State persists on the
+        estimator across epochs; ``self._result`` tracks it so
+        ``get_model``/``export_serving`` work mid-stream."""
+        import jax
+        import time as _time
+
+        from raydp_tpu.data.feed import DeviceFeed
+
+        o = getattr(self, "_online", None)
+        if o is None:
+            o = self._online_init(ds)
+            if o is None:
+                # an empty first epoch (a filter matching nothing is
+                # routine in streaming) has no schema to init from: report
+                # it and keep waiting for rows
+                return {"epoch": epoch, "train_loss": float("nan"),
+                        "steps": 0, "samples_per_s": 0.0,
+                        "epoch_time_s": 0.0, "decode_time_s": 0.0,
+                        "h2d_time_s": 0.0}
+            self._online = o
+        feed = DeviceFeed(ds, self.batch_size, o["columns"], mesh=o["mesh"],
+                          shuffle=False, drop_remainder=o["drop_last"],
+                          prefetch_to_device=self.prefetch_to_device)
+        t0 = _time.perf_counter()
+        mstats = tuple(m.init() for m in self._metrics)
+        loss_sum = np.zeros((), np.float32)
+        steps = 0
+        for batch in feed:
+            o["state"], loss_sum, mstats = o["jit_train"](
+                o["state"], batch, mstats, loss_sum)
+            steps += 1
+        train_loss = float(loss_sum) / steps if steps else float("nan")
+        dt = _time.perf_counter() - t0
+        pipe = feed.timings.take()
+        report = {
+            "epoch": epoch,
+            "train_loss": train_loss,
+            "steps": steps,
+            "samples_per_s": (steps * self.batch_size / dt) if dt > 0
+            else 0.0,
+            "epoch_time_s": dt,
+            "decode_time_s": pipe.get("decode", 0.0),
+            "h2d_time_s": pipe.get("h2d", 0.0),
+        }
+        for m, s in zip(self._metrics, mstats):
+            report[f"train_{m.name}"] = m.compute(
+                jax.tree.map(np.asarray, s))
+        o["history"].append(report)
+        self._result = TrainingResult(state=o["state"],
+                                      history=o["history"])
+        return report
+
+    def _online_init(self, ds) -> Optional[Dict[str, Any]]:
+        """Build the persistent online-training state from the first
+        epoch's schema: model/optimizer init, sharded placement, and the
+        jitted train step (the same step shape as ``fit``'s, without the
+        chaining/device-resident variants — a stream epoch is small).
+        None when the epoch holds no rows to init from."""
+        import jax
+        import jax.numpy as jnp
+        from flax.training import train_state
+
+        from raydp_tpu.data.feed import HostBatchIterator
+        from raydp_tpu.parallel import param_sharding_rules
+        from raydp_tpu.parallel.mesh import data_axes
+
+        mesh = self._build_mesh()
+        columns = self._columns()
+        model = self._build_model()
+        tx = self._build_optimizer()
+        loss_fn = _resolve_loss(self._loss)
+        metrics = self._metrics
+        first = next(iter(HostBatchIterator(ds, 1, columns, shuffle=False,
+                                            drop_remainder=False)), None)
+        if first is None:
+            return None
+        inputs0, _ = self._split_batch(
+            {k: jnp.asarray(v[:1]) for k, v in first.items()})
+        rng = jax.random.PRNGKey(self.seed)
+        takes_train = _takes_train(model)
+        init_kwargs = {"train": False} if takes_train else {}
+        variables = model.init(rng, inputs0, **init_kwargs)
+
+        class _State(train_state.TrainState):
+            batch_stats: Any = None
+
+        state = _State.create(apply_fn=model.apply,
+                              params=variables["params"], tx=tx,
+                              batch_stats=variables.get("batch_stats"))
+        state = self._place_state(
+            state, param_sharding_rules(mesh, self.param_rules)(state))
+
+        compute_dtype = self.compute_dtype
+        split_batch = self._split_batch
+
+        def train_step(state, batch, mstats, loss_sum):
+            def _loss(params):
+                inputs, labels = split_batch(batch)
+                inputs = _cast_floating(inputs, compute_dtype)
+                vs = {"params": params}
+                kwargs = {"train": True} if takes_train else {}
+                new_bstats = None
+                if state.batch_stats is not None:
+                    vs["batch_stats"] = state.batch_stats
+                    preds, updates = model.apply(
+                        vs, inputs, mutable=["batch_stats"], **kwargs)
+                    new_bstats = updates["batch_stats"]
+                else:
+                    preds = model.apply(vs, inputs, **kwargs)
+                if preds.ndim == labels.ndim + 1 and preds.shape[-1] == 1:
+                    preds = preds.squeeze(-1)
+                preds = preds.astype(jnp.float32)
+                return loss_fn(preds, labels), (preds, new_bstats)
+
+            (loss_val, (preds, new_bstats)), grads = jax.value_and_grad(
+                _loss, has_aux=True)(state.params)
+            new_state = state.apply_gradients(grads=grads)
+            if new_bstats is not None:
+                new_state = new_state.replace(batch_stats=new_bstats)
+            _, labels = split_batch(batch)
+            new_mstats = tuple(
+                m.update(s, preds, labels) for m, s in zip(metrics, mstats))
+            return (new_state, loss_sum + loss_val.astype(jnp.float32),
+                    new_mstats)
+
+        dp_total = int(np.prod([mesh.shape[a] for a in data_axes(mesh)]))
+        return {
+            "mesh": mesh,
+            "columns": columns,
+            "state": state,
+            "jit_train": jax.jit(train_step, donate_argnums=(0, 3)),
+            # a ragged micro-batch tail cannot shard over a >1 data axis
+            # (the eval-feed rule in fit), so it drops there; a size-1 data
+            # extent trains every row — dropping an online epoch's tail
+            # would silently skip whole small micro-batches
+            "drop_last": dp_total > 1,
+            "history": [],
+        }
+
     # --------------------------------------------------------------- fit_gang
     def fit_gang(self, train_ds, evaluate_ds=None, *, num_workers: int = 2,
                  max_retries: int = 0, job_name: Optional[str] = None,

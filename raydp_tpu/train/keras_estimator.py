@@ -950,6 +950,43 @@ class KerasEstimator(EstimatorInterface, FrameEstimatorInterface):
         return history
 
     # ----------------------------------------------------------- fit_on_frame
+    # ------------------------------------------------------------ partial_fit
+    def _partial_fit_epoch(self, ds, epoch: int) -> Dict[str, float]:
+        """One online update, keras flavor: the compiled model persists on
+        the estimator and ``model.fit(epochs=1)`` advances it over the
+        epoch's materialized rows (keras fit is incremental by contract —
+        weights are never reinitialized between calls)."""
+        import time as _time
+
+        keras = _import_keras()
+        model = self._trained_model
+        if model is None or not getattr(self, "_online_compiled", False):
+            keras.utils.set_random_seed(self.seed)
+            model = self._build_model()
+            model.compile(optimizer=keras.saving.deserialize_keras_object(
+                self._optimizer_spec), loss=self._loss,
+                metrics=list(self._metrics))
+            self._trained_model = model
+            self._online_compiled = True
+            self._online_history: List[Dict[str, float]] = []
+        t0 = _time.perf_counter()
+        x, y = self._materialize(ds)
+        hist = model.fit(x, y, batch_size=self.batch_size, epochs=1,
+                         shuffle=False, verbose=0)
+        dt = _time.perf_counter() - t0
+        report = {"epoch": epoch, "epoch_time_s": dt,
+                  "steps": int(np.ceil(len(x) / self.batch_size)),
+                  "samples_per_s": len(x) / dt if dt > 0 else 0.0}
+        for k, v in hist.history.items():
+            report[f"train_{k}" if not k.startswith("train_") else k] = \
+                float(v[-1])
+        if "train_loss" in report:
+            report["train_loss"] = float(report["train_loss"])
+        self._online_history.append(report)
+        self._result = TrainingResult(state=None,
+                                      history=self._online_history)
+        return report
+
     def fit_on_frame(self, train_df, evaluate_df=None, *,
                      fs_directory: Optional[str] = None,
                      stop_etl_after_conversion: bool = False,

@@ -1472,3 +1472,87 @@ def test_admission_composes_with_autoscale_and_drain(tmp_path, monkeypatch):
             "the parked action was rejected instead of admitted"
     finally:
         raydp_tpu.stop()
+
+
+# ---------------------------------------------------------------------------
+# continuous pipelines (ISSUE 15): the streaming fault matrix
+# ---------------------------------------------------------------------------
+
+def _run_stream_windows(app, epochs=5, rows=1200):
+    """One full session driving a windowed continuous pipeline; returns
+    (list of (start, end, window ipc bytes), epoch result bytes, report).
+    Window tables are already key-sorted by the pipeline (the groupagg
+    row-order caveat of _run_groupagg, handled once in _merge_window)."""
+    from raydp_tpu import stream
+    from raydp_tpu.etl.expressions import col
+
+    def make(epoch):
+        rng = np.random.RandomState(epoch)
+        return pa.table({
+            "k": rng.randint(0, 16, rows),
+            "v": rng.randint(0, 1000, rows).astype(np.int64),
+        })
+
+    s = _session(app)
+    try:
+        from raydp_tpu.runtime.object_store import get_client
+        client = get_client()
+        before = client.stats()["num_objects"]
+        pipe = stream.read_stream(
+            stream.SyntheticSource(make, max_epochs=epochs)).transform(
+            lambda df: df.filter(col("v") % 7 != 0)).window(
+            size=3, slide=1, keys=["k"], aggs={"v": ["sum", "count"]})
+        wins, epochs_b = [], []
+        for er in pipe.epochs():
+            epochs_b.append(_ipc_bytes(er.table()))
+            wins.extend((w.start, w.end, _ipc_bytes(w.table))
+                        for w in er.windows)
+        rep = pipe.report()
+        pipe.close()
+        deadline = time.time() + 30
+        while time.time() < deadline \
+                and client.stats()["num_objects"] != before:
+            time.sleep(0.25)
+        orphans = client.stats()["num_objects"] - before
+        return wins, epochs_b, rep, orphans
+    finally:
+        raydp_tpu.stop()
+
+
+def test_stream_executor_crash_mid_epoch_byte_identical(tmp_path,
+                                                        monkeypatch):
+    """An executor crash in the middle of an epoch's engine action: the
+    lineage plane re-runs the lost tasks INSIDE the epoch (the stream layer
+    never notices), and every epoch result and window merge is
+    byte-identical to the fault-free run with zero orphans."""
+    base_w, base_e, _, orphans0 = _run_stream_windows("stream-crash-base")
+    assert orphans0 == 0
+
+    crash_s = str(tmp_path / "stream-crash.sentinel")
+    monkeypatch.setenv(
+        "RDT_FAULTS", f"executor.run_task:crash:nth=4:once={crash_s}")
+    got_w, got_e, rep, orphans = _run_stream_windows("stream-crash")
+    assert os.path.exists(crash_s), "injected crash never fired"
+    assert got_e == base_e, "epoch results diverged after the crash"
+    assert got_w == base_w, "window results diverged after the crash"
+    assert orphans == 0, f"crash replay orphaned {orphans} store objects"
+
+
+def test_stream_epoch_drop_replays_exactly_once(tmp_path, monkeypatch):
+    """The stream's own fault site: ``stream.epoch:drop`` loses a freshly
+    sealed epoch's partial blobs post-commit (the store-host-died model for
+    streams). The window merges spanning the lost epoch must re-derive it
+    from the source journal — results byte-identical to the unfaulted run,
+    each epoch contributing exactly once, zero orphans."""
+    base_w, base_e, base_rep, _ = _run_stream_windows("stream-drop-base")
+    assert base_rep["replays"] == 0
+
+    sent = str(tmp_path / "stream-drop.sentinel")
+    monkeypatch.setenv("RDT_FAULTS",
+                       f"stream.epoch:drop:nth=2:once={sent}")
+    got_w, got_e, rep, orphans = _run_stream_windows("stream-drop")
+    assert os.path.exists(sent), "injected drop never fired"
+    assert rep["replays"] >= 1, "the lost epoch was never replayed"
+    assert got_w == base_w, "window results diverged after the drop"
+    assert got_e == base_e
+    assert orphans == 0, f"epoch replay orphaned {orphans} store objects"

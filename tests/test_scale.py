@@ -745,6 +745,109 @@ def test_admission_parked_action_admitted_when_backlog_drains(monkeypatch):
     assert all(r is not None for r in out)
 
 
+def test_admission_fifo_first_parked_first_admitted(monkeypatch):
+    """Freed backlog admits parked actions in PARK ORDER (ROADMAP 3c):
+    four actions park behind a synthetic flood; when the flood drains, they
+    must admit first-parked-first — not in whichever order their poll loops
+    happened to wake (the pre-FIFO race)."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    monkeypatch.setenv("RDT_POOL_MAX_QUEUED", "10")
+    monkeypatch.setenv("RDT_ADMIT_TIMEOUT_S", "30")
+    pool = ExecutorPool([StubExecutor(name="e0")])
+    flood = 20
+    with pool._lock:
+        pool._demand += flood
+    order = []
+
+    def admit(tag):
+        # the real callers register demand before _admit and release after
+        with pool._lock:
+            pool._demand += 4
+        pool._admit(tag, 4)
+        order.append(tag)
+        with pool._lock:
+            pool._demand -= 4
+
+    threads = []
+    for tag in ("first", "second", "third", "fourth"):
+        t = threading.Thread(target=admit, args=(tag,))
+        t.start()
+        threads.append(t)
+        # park order IS ticket order: wait until THIS one is parked before
+        # starting the next
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with pool._lock:
+                if pool._parked_by_tenant.get(tag):
+                    break
+            time.sleep(0.005)
+    with pool._lock:
+        assert pool._park_queue == sorted(pool._park_queue)
+        assert len(pool._park_queue) == 4
+        pool._demand -= flood  # the flood drains: the whole backlog frees
+    for t in threads:
+        t.join(timeout=30)
+    assert order == ["first", "second", "third", "fourth"]
+    with pool._lock:
+        assert pool._park_queue == []
+        assert pool._parked_by_tenant == {}
+        pool._demand = 0
+
+
+def test_admission_fifo_newcomer_queues_behind_parked(monkeypatch):
+    """A fresh arrival that WOULD fit must still queue behind an
+    already-parked action instead of jumping it (first parked, first
+    admitted covers admission order, not just wakeup order)."""
+    monkeypatch.setenv("RDT_SPECULATION", "0")
+    monkeypatch.setenv("RDT_POOL_MAX_QUEUED", "10")
+    monkeypatch.setenv("RDT_ADMIT_TIMEOUT_S", "30")
+    pool = ExecutorPool([StubExecutor(name="e0")])
+    with pool._lock:
+        pool._demand += 12   # flood: backlog 12 > 10 parks anything
+    order = []
+
+    def admit(tag, n):
+        with pool._lock:
+            pool._demand += n
+        pool._admit(tag, n)
+        order.append(tag)
+        with pool._lock:
+            pool._demand -= n
+
+    big = threading.Thread(target=admit, args=("big", 8))
+    big.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with pool._lock:
+            if pool._parked_by_tenant.get("big"):
+                break
+        time.sleep(0.005)
+    # drain the flood to 5: big still cannot fit (5+8 > 10) but a small
+    # newcomer WOULD (5+2 <= 10) — pre-FIFO it would jump straight past
+    # the parked big action; now it must park behind it
+    with pool._lock:
+        pool._demand -= 7
+    small = threading.Thread(target=admit, args=("small", 2))
+    small.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with pool._lock:
+            if pool._parked_by_tenant.get("small"):
+                break
+        time.sleep(0.005)
+    with pool._lock:
+        assert pool._parked_by_tenant.get("small") == 2, \
+            "the fitting newcomer jumped the parked queue"
+        assert order == []
+        pool._demand -= 5  # now the flood is gone: both admit, in order
+    big.join(timeout=30)
+    small.join(timeout=30)
+    assert order == ["big", "small"]
+    with pool._lock:
+        assert pool._park_queue == [] and pool._parked_by_tenant == {}
+        pool._demand = 0
+
+
 def test_backpressure_pauses_and_resumes_dispatch(monkeypatch):
     """A host above the store high-watermark takes no dispatch until it
     drops below the low-watermark; with every host paused, tasks wait

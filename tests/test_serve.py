@@ -694,3 +694,168 @@ def test_hedging_suppressed_while_shedding(monkeypatch):
                 assert wall >= 0.9, "suppressed hedge still cut the tail?"
         finally:
             srv.close()
+
+
+# ---------------------------------------------------------------------------
+# hot swap (ISSUE 15): versioned servables under live traffic
+# ---------------------------------------------------------------------------
+
+class VersionedFakeReplica(FakeReplicaHandle):
+    """A fake whose answer depends on the LOADED bundle: a replica id
+    loaded from ``.../vN`` answers ``(N + 1) * v`` — so every response
+    names the exact servable version that computed it."""
+
+    def __init__(self, name, delay_s=0.0):
+        super().__init__(name, delay_s=delay_s)
+        self.dirs: dict = {}        # rid -> export dir
+        self.unloaded: list = []
+
+    def call(self, method, *args, timeout=None, **kwargs):
+        if method == "serve_unload":
+            with self._lock:
+                self.dirs.pop(args[0], None)
+                self.unloaded.append(args[0])
+            return True
+        return super().call(method, *args, timeout=timeout, **kwargs)
+
+    def submit(self, method, *args, **kwargs):
+        if method == "serve_load":
+            rid, export_dir = args
+            with self._lock:
+                self.dirs[rid] = export_dir
+                self.loads += 1
+            fut = Future()
+            fut.set_result({"replica": rid})
+            return fut
+        assert method == "serve_predict"
+        rid, payload = args
+        with self._lock:
+            self.calls += 1
+            mult = int(self.dirs[rid].rsplit("v", 1)[1]) + 1
+        fut = Future()
+        threading.Thread(target=self._serve_versioned,
+                         args=(payload, fut, mult), daemon=True).start()
+        return fut
+
+    def _serve_versioned(self, payload, fut, mult):
+        d = self.delay_s() if callable(self.delay_s) else self.delay_s
+        if d:
+            time.sleep(d)
+        table = _decode_payload(payload)
+        v = table.column("v").to_numpy(zero_copy_only=False)
+        fut.set_result((v * float(mult)).astype(np.float32))
+
+
+def test_hot_swap_shifts_traffic_and_reports_active_version(monkeypatch):
+    monkeypatch.setenv("RDT_SERVE_BATCH_TIMEOUT_MS", "5")
+    monkeypatch.setenv("RDT_SERVE_HEDGE", "0")
+    monkeypatch.setenv("RDT_SERVE_SWAP_DRAIN_S", "5")
+    reps = [VersionedFakeReplica("a"), VersionedFakeReplica("b")]
+    srv = ServingSession("/bundles/v1", executors=reps, name="hs")
+    try:
+        assert np.array_equal(srv.predict(_rows(1.0, 2.0)), [2.0, 4.0])
+        rep = srv.serving_report()
+        assert rep["servable"] == {"version": 1,
+                                   "export_dir": "/bundles/v1",
+                                   "tag": None}
+        info = srv.hot_swap("/bundles/v2", tag="epoch-9")
+        assert info["version"] == 2
+        assert info["replicas"] == ["hs-v2-r0", "hs-v2-r1"]
+        # every post-swap dispatch answers from v2
+        assert np.array_equal(srv.predict(_rows(1.0, 2.0)), [3.0, 6.0])
+        rep = srv.serving_report()
+        assert rep["servable"] == {"version": 2,
+                                   "export_dir": "/bundles/v2",
+                                   "tag": "epoch-9"}
+        assert rep["hot_swaps"] == 1
+        # the old version retires (drained: nothing in flight on it)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline \
+                and not all(h.unloaded for h in reps):
+            time.sleep(0.02)
+        assert [u for h in reps for u in h.unloaded] \
+            == ["hs-r0", "hs-r1"]
+    finally:
+        srv.close()
+
+
+def test_hot_swap_racing_predict_burst_zero_dropped(monkeypatch):
+    """The ISSUE 15 race leg at unit precision: a predict burst straddles
+    two hot-swaps while the outgoing version still holds in-flight work
+    (a scripted apply delay) — zero dropped requests, and every response
+    is the output of exactly ONE servable version (2v, 3v or 4v — never a
+    mix within one request, never a value from no version)."""
+    monkeypatch.setenv("RDT_SERVE_BATCH_TIMEOUT_MS", "2")
+    monkeypatch.setenv("RDT_SERVE_HEDGE", "0")
+    monkeypatch.setenv("RDT_SERVE_SWAP_DRAIN_S", "3")
+    reps = [VersionedFakeReplica("a", delay_s=0.01),
+            VersionedFakeReplica("b", delay_s=0.01)]
+    srv = ServingSession("/bundles/v1", executors=reps, name="race")
+    try:
+        stop = threading.Event()
+        futs, errors = [], []
+
+        def fire():
+            i = 0
+            while not stop.is_set():
+                try:
+                    futs.append((float(i), srv.predict_async(
+                        _rows(float(i)))))
+                except Exception as e:  # noqa: BLE001 - counted
+                    errors.append(repr(e))
+                i += 1
+                time.sleep(0.001)
+
+        t = threading.Thread(target=fire)
+        t.start()
+        time.sleep(0.05)
+        srv.hot_swap("/bundles/v2", tag="epoch-2")
+        time.sleep(0.05)
+        srv.hot_swap("/bundles/v3", tag="epoch-4")
+        time.sleep(0.05)
+        stop.set()
+        t.join(timeout=30)
+        assert not errors, errors
+        assert len(futs) > 20
+        versions = set()
+        for v, f in futs:
+            got = f.result(timeout=30.0)
+            assert got.shape == (1,)
+            if v == 0.0:
+                continue  # 0 is version-blind
+            mult = got[0] / v
+            # exactly one version answered: the multiplier is one of the
+            # three loaded servables', bit-exact
+            assert mult in (2.0, 3.0, 4.0), (v, got)
+            versions.add(mult)
+        assert len(versions) >= 2, "burst never straddled a swap"
+        rep = srv.serving_report()
+        assert rep["hot_swaps"] == 2
+        assert rep["failed"] == 0 and rep["shed"] == 0
+        assert rep["servable"]["version"] == 3
+        assert rep["servable"]["tag"] == "epoch-4"
+    finally:
+        srv.close()
+
+
+def test_hot_swap_drain_waits_for_inflight_then_unloads(monkeypatch):
+    """Retirement semantics: the outgoing version's in-flight dispatch
+    completes (no drop), and its replicas unload only after the drain."""
+    monkeypatch.setenv("RDT_SERVE_BATCH_TIMEOUT_MS", "2")
+    monkeypatch.setenv("RDT_SERVE_HEDGE", "0")
+    monkeypatch.setenv("RDT_SERVE_SWAP_DRAIN_S", "10")
+    slow = VersionedFakeReplica("slow", delay_s=0.3)
+    srv = ServingSession("/bundles/v1", executors=[slow], name="drain")
+    try:
+        f = srv.predict_async(_rows(5.0))   # in flight on v1, 300ms apply
+        time.sleep(0.05)
+        srv.hot_swap("/bundles/v2")
+        assert not slow.unloaded            # v1 still busy: not retired yet
+        assert np.array_equal(f.result(timeout=30.0), [10.0])  # v1 answered
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not slow.unloaded:
+            time.sleep(0.02)
+        assert slow.unloaded == ["drain-r0"]
+        assert np.array_equal(srv.predict(_rows(5.0)), [15.0])  # v2 now
+    finally:
+        srv.close()
