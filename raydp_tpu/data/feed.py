@@ -53,6 +53,38 @@ class ShardSpec:
 
 ColumnSpec = Union[str, Sequence[str]]
 
+#: batch-dict key carrying the per-row validity mask under pad-and-mask mode
+#: (1.0 = real row, 0.0 = padding). Present on EVERY batch a padding feed
+#: yields — a constant pytree structure keeps the jitted step at one
+#: compilation — and threaded by the estimators into loss/metric
+#: accumulators so padded rows contribute nothing.
+MASK_KEY = "__mask__"
+
+
+def pad_batch(batch: Dict[str, np.ndarray], batch_size: int
+              ) -> Dict[str, np.ndarray]:
+    """Zero-pad a ragged host batch up to ``batch_size`` rows and attach the
+    validity mask. Shapes come out static (one XLA program) and divisible by
+    any data-axis extent that divides ``batch_size`` — the alternative the
+    pre-pad feed took was silently DROPPING the tail rows under a >1 data
+    axis."""
+    rows = int(next(iter(batch.values())).shape[0])
+    pad = batch_size - rows
+    if pad < 0:
+        raise ValueError(f"batch of {rows} rows exceeds batch_size "
+                         f"{batch_size}")
+    mask = np.zeros(batch_size, np.float32)
+    mask[:rows] = 1.0
+    if pad:
+        batch = {n: np.concatenate(
+            [a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
+            for n, a in batch.items()}
+        metrics.inc("train_padded_rows_total", pad)
+    else:
+        batch = dict(batch)
+    batch[MASK_KEY] = mask
+    return batch
+
 
 def epoch_seed(base: int, epoch: int) -> int:
     """Deterministic per-epoch shuffle seed — THE derivation every feed path
@@ -113,6 +145,7 @@ class HostBatchIterator:
         drop_remainder: bool = True,
         cache_decoded: bool = True,
         cache_cap_bytes: Optional[int] = None,
+        pad_remainder: bool = False,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -120,7 +153,11 @@ class HostBatchIterator:
         self.shard = shard
         self.shuffle = shuffle
         self.seed = seed
-        self.drop_remainder = drop_remainder
+        self.drop_remainder = drop_remainder and not pad_remainder
+        #: pad-and-mask mode: the ragged tail pads to a full batch and EVERY
+        #: batch carries :data:`MASK_KEY` (constant pytree structure — one
+        #: jit compilation); wins over drop_remainder
+        self.pad_remainder = pad_remainder
         self.cache_decoded = cache_decoded
         # per-iterator budget (train and eval feeds each get their own); env
         # read at construction so callers can tune it after import
@@ -202,10 +239,12 @@ class HostBatchIterator:
             buffered += length
             while buffered >= self.batch_size:
                 batch, buffers, buffered = self._cut_batch(buffers, buffered)
-                yield batch
+                yield pad_batch(batch, self.batch_size) \
+                    if self.pad_remainder else batch
         if buffered > 0 and not self.drop_remainder:
             batch = {n: np.concatenate(v, axis=0) for n, v in buffers.items()}
-            yield batch
+            yield pad_batch(batch, self.batch_size) \
+                if self.pad_remainder else batch
 
     def _cut_batch(self, buffers, buffered):
         joined = {n: (np.concatenate(v, axis=0) if len(v) > 1 else v[0])
@@ -709,6 +748,7 @@ class DeviceFeed:
         drop_remainder: bool = True,
         host_iter=None,
         prefetch_to_device: Optional[int] = None,
+        pad_remainder: bool = False,
     ):
         import jax
         self._jax = jax
@@ -716,7 +756,8 @@ class DeviceFeed:
         self.data_axis = data_axis
         self.host_iter = host_iter if host_iter is not None else HostBatchIterator(
             dataset, batch_size, columns, shard=shard, shuffle=shuffle,
-            seed=seed, drop_remainder=drop_remainder)
+            seed=seed, drop_remainder=drop_remainder,
+            pad_remainder=pad_remainder)
         self.prefetch = max(1, prefetch)
         if prefetch_to_device is None:
             prefetch_to_device = int(knobs.get("RDT_PREFETCH_TO_DEVICE"))

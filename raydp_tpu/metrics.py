@@ -217,6 +217,13 @@ _ALL_METRICS = [
        label="phase"),
     _m("train_epoch_seconds", HISTOGRAM, "s", "training",
        "Wall-clock of one training epoch (both estimators)."),
+    _m("train_param_bytes_per_process", GAUGE, "bytes", "training",
+       "Params + optimizer state resident on this process's devices after "
+       "sharded placement (replicated leaves count one copy per device) — "
+       "the fsdp-vs-replicated HBM headroom measure."),
+    _m("train_padded_rows_total", COUNTER, "rows", "training",
+       "Zero rows appended by pad-and-mask feeds to square a ragged final "
+       "batch; each padded row is masked out of losses and metrics."),
 ]
 
 METRICS: Dict[str, Metric] = {m.name: m for m in _ALL_METRICS}
@@ -277,6 +284,11 @@ _ALL_SPANS = [
     _s("stream:window", "stream",
        "One windowed-aggregation merge over the epoch partials of a "
        "closing window (including any replay rounds)."),
+    # ---- training -----------------------------------------------------------
+    _s("train:place", "training",
+       "Sharded placement of the train state onto the mesh (host → device "
+       "under each leaf's PartitionSpec; covers the initial FSDP/TP scatter "
+       "or replication)."),
 ]
 
 SPANS: Dict[str, Span] = {s.name: s for s in _ALL_SPANS}

@@ -19,6 +19,12 @@ from raydp_tpu.parallel.mesh import (
     shard_params,
 )
 from raydp_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from raydp_tpu.parallel.roles import (
+    addressable_nbytes,
+    classify_param,
+    describe_roles,
+    role_partition_spec,
+)
 
 __all__ = [
     "MeshSpec",
@@ -29,4 +35,8 @@ __all__ = [
     "shard_params",
     "pipeline_apply",
     "stack_stage_params",
+    "classify_param",
+    "role_partition_spec",
+    "describe_roles",
+    "addressable_nbytes",
 ]

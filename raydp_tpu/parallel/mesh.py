@@ -20,7 +20,7 @@ pick a mesh, annotate shardings, let XLA insert collectives.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -57,13 +57,23 @@ class MeshSpec:
         return {"data": data, **fixed}
 
 
-def make_mesh(spec: Optional[MeshSpec] = None, devices=None,
-              axis_names: Sequence[str] = AXES):
-    """Build a ``jax.sharding.Mesh`` over all (or given) devices."""
+def make_mesh(spec: Optional[Union[MeshSpec, Dict[str, int]]] = None,
+              devices=None, axis_names: Sequence[str] = AXES):
+    """Build a ``jax.sharding.Mesh`` over all (or given) devices.
+
+    ``spec`` may be a :class:`MeshSpec` or a plain axis-size dict
+    (``dict(fsdp=4, tensor=2)``) — the estimator's ``mesh_spec=`` argument
+    accepts either, so callers need not import MeshSpec to go sharded."""
     import jax
     from jax.sharding import Mesh
 
     devices = list(devices if devices is not None else jax.devices())
+    if isinstance(spec, dict):
+        unknown = set(spec) - set(AXES)
+        if unknown:
+            raise ValueError(f"unknown mesh axes {sorted(unknown)}; "
+                             f"have {AXES}")
+        spec = MeshSpec(**spec)
     spec = spec or MeshSpec()
     sizes = spec.sizes(len(devices))
     shape = tuple(sizes[a] for a in axis_names)
@@ -116,20 +126,31 @@ def param_sharding_rules(mesh, rules: Optional[List[Tuple[str, Tuple]]] = None):
     """Compile path-pattern → PartitionSpec rules into a tree-mapping function.
 
     ``rules`` is an ordered list of ``(substring, spec_tuple)``; the first
-    matching substring of the parameter path wins; default is replicated (pure
-    DP, the reference's only strategy) or fsdp sharding on the largest dim when
-    an ``fsdp`` axis is present.
+    matching substring of the parameter path wins. Leaves no rule matches go
+    to the role policy (:mod:`raydp_tpu.parallel.roles` — embeddings over
+    fsdp×tensor, kernels over fsdp/tensor by dimension, biases replicated;
+    opt out with ``RDT_TRAIN_SHARD_ROLES=0``), whose fallback-of-last-resort
+    matches the legacy behavior: replicated (pure DP, the reference's only
+    strategy), or fsdp sharding on the largest divisible dim when an ``fsdp``
+    axis is present.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
+    from raydp_tpu import knobs
+    from raydp_tpu.parallel.roles import role_partition_spec
+
     fsdp = mesh.shape.get("fsdp", 1) > 1
+    use_roles = bool(knobs.get("RDT_TRAIN_SHARD_ROLES"))
 
     def spec_for(path: str, leaf) -> NamedSharding:
         if rules:
             for pat, spec in rules:
                 if pat in path:
                     return NamedSharding(mesh, PartitionSpec(*spec))
+        if use_roles:
+            return NamedSharding(mesh, role_partition_spec(
+                mesh, path, tuple(getattr(leaf, "shape", ()))))
         if fsdp and hasattr(leaf, "ndim") and leaf.ndim >= 1:
             dims = getattr(leaf, "shape", ())
             if dims:

@@ -1,0 +1,130 @@
+"""Role-driven parameter sharding: pytree path → PartitionSpec.
+
+The mesh (:mod:`raydp_tpu.parallel.mesh`) has carried ``fsdp``/``tensor``
+axes since the seed, but choosing a PartitionSpec per parameter was left to
+hand-written ``param_rules``. This module is the SpecLayout-style policy that
+closes the gap: classify every parameter (and optimizer-state leaf) by its
+*role* — read off the pytree path and the leaf's shape — and emit the spec
+that role wants on this mesh:
+
+- **embedding tables** (path names an embedding, 2-D): rows sharded over
+  ``fsdp`` × ``tensor`` — the vocab dim is the big dim and gathers are
+  per-lookup, so both axes pay off together;
+- **projection / dense kernels** (≥ 2-D): Megatron-style ``tensor`` on the
+  output (last) dim, ``fsdp`` on the largest remaining dim — FSDP all-gathers
+  params per layer so its dim choice is a memory layout, not a math change;
+- **biases / norm scales / scalars** (≤ 1-D): replicated — sharding a few
+  hundred bytes buys nothing and costs a gather.
+
+A dim is only ever sharded when the axis has size > 1 **and** divides it;
+anything unshardable degrades axis by axis down to replicated, so the policy
+is total (never raises on an odd shape). Optimizer state inherits its
+parameter's spec for free: optax moment trees (adam ``mu``/``nu``) mirror the
+parameter paths and shapes, so the same classification fires — the FSDP
+memory win covers the Adam moments, not just the weights.
+
+``param_sharding_rules`` consults this policy (behind ``RDT_TRAIN_SHARD_ROLES``)
+whenever no explicit rule matches, so ``mesh_spec=dict(fsdp=..., tensor=...)``
+alone yields a fully sharded train state.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+#: path substrings that mark an embedding table (lowercased match). "embed"
+#: catches flax ``nn.Embed`` scopes and the conventional ``embedding`` /
+#: ``embed_tokens`` / ``token_embedder`` spellings in one token.
+EMBEDDING_TOKENS = ("embed",)
+
+REPLICATED = "replicated"
+EMBEDDING = "embedding"
+KERNEL = "kernel"
+
+
+def classify_param(path: str, shape: Tuple[int, ...]) -> str:
+    """The role of one leaf: ``embedding`` | ``kernel`` | ``replicated``.
+
+    Works on parameter paths AND their optimizer-state mirrors (e.g.
+    ``opt_state/0/mu/Dense_0/kernel`` classifies like the kernel itself);
+    scalars (step counts) and 1-D leaves (biases, norm scales) replicate.
+    """
+    ndim = len(shape)
+    if ndim <= 1:
+        return REPLICATED
+    low = path.lower()
+    if ndim == 2 and any(tok in low for tok in EMBEDDING_TOKENS):
+        return EMBEDDING
+    return KERNEL
+
+
+def _divides(dim: int, size: int) -> bool:
+    return size > 1 and dim > 1 and dim % size == 0
+
+
+def role_partition_spec(mesh, path: str, shape: Tuple[int, ...]):
+    """The PartitionSpec the leaf's role wants on ``mesh`` (total: degrades
+    to replicated whenever an axis is absent, size 1, or does not divide)."""
+    from jax.sharding import PartitionSpec
+
+    fsdp = int(mesh.shape.get("fsdp", 1))
+    tensor = int(mesh.shape.get("tensor", 1))
+    role = classify_param(path, shape)
+    if role == REPLICATED or (fsdp <= 1 and tensor <= 1):
+        return PartitionSpec()
+
+    spec: list = [None] * len(shape)
+    if role == EMBEDDING:
+        # rows (vocab) over the fsdp×tensor product when it divides; else
+        # whichever single axis does; embedding dim stays replicated
+        rows = shape[0]
+        if _divides(rows, fsdp * tensor) and fsdp > 1 and tensor > 1:
+            spec[0] = ("fsdp", "tensor")
+        elif _divides(rows, fsdp):
+            spec[0] = "fsdp"
+        elif _divides(rows, tensor):
+            spec[0] = "tensor"
+        return PartitionSpec(*spec)
+
+    # kernels: tensor on the output (last) dim, fsdp on the largest
+    # remaining divisible dim (deterministic tie-break: lower index wins)
+    if _divides(shape[-1], tensor):
+        spec[-1] = "tensor"
+    if fsdp > 1:
+        order = sorted(range(len(shape)), key=lambda i: (-shape[i], i))
+        for i in order:
+            if spec[i] is None and _divides(shape[i], fsdp):
+                spec[i] = "fsdp"
+                break
+    return PartitionSpec(*spec)
+
+
+def describe_roles(tree) -> dict:
+    """Debug/bench helper: path → (role, shape) for every leaf of ``tree``."""
+    import jax
+
+    out = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        path_str = "/".join(
+            str(getattr(p, "key", getattr(p, "name", p))) for p in path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        out[path_str] = (classify_param(path_str, shape), shape)
+    return out
+
+
+def addressable_nbytes(tree) -> int:
+    """Bytes of ``tree`` actually resident on THIS process's devices —
+    replicated leaves count one copy per addressable device (that IS the
+    memory they occupy), sharded leaves only their local shards. The number
+    the fsdp-vs-replicated HBM headroom claim is measured in."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is not None:
+            total += sum(s.data.nbytes for s in shards)
+        elif hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+    return total

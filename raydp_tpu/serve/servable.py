@@ -58,9 +58,21 @@ def _template_spec(state):
 
 
 def _host_tree(state):
+    """Host-side copy of the weight tree where one exists. A mesh-trained
+    state whose shards span PROCESSES has no single-host value —
+    ``np.asarray`` would throw — so such leaves pass through as global
+    arrays: ``checkpoint.save`` routes them to the sharded multi-writer
+    format (every process writes the shards it owns), and ``load_servable``
+    reassembles via the same cross-topology restore a resumed gang uses.
+    Single-process sharded arrays (any mesh shape) gather here as before."""
     import jax
 
-    return jax.tree.map(lambda x: np.asarray(x), state)
+    def _host(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return x
+        return np.asarray(x)
+
+    return jax.tree.map(_host, state)
 
 
 def export_bundle(export_dir: str, kind: str, bundle: Dict[str, Any],

@@ -29,7 +29,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from raydp_tpu import faults
+from raydp_tpu import faults, knobs
 from raydp_tpu.log import get_logger
 from raydp_tpu.train.estimator import (
     EstimatorInterface,
@@ -76,6 +76,19 @@ def _cast_floating(inputs, dtype):
         if jnp.issubdtype(a.dtype, jnp.floating) else a, inputs)
 
 
+def _masked_mean(x, mask):
+    """Mean of ``x`` over REAL rows only: per-row reduce the non-batch dims,
+    then weight by the 0/1 mask. ``mask=None`` is a plain mean — bit-for-bit
+    the pre-mask loss, so unpadded feeds are untouched."""
+    import jax.numpy as jnp
+
+    if mask is None:
+        return jnp.mean(x)
+    if x.ndim > 1:
+        x = jnp.mean(x, axis=tuple(range(1, x.ndim)))
+    return jnp.sum(x * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
 def _resolve_loss(loss) -> Callable:
     import jax.numpy as jnp
 
@@ -83,26 +96,30 @@ def _resolve_loss(loss) -> Callable:
         return loss
     name = (loss or "mse").lower()
 
-    def mse(preds, labels):
-        return jnp.mean((preds - labels) ** 2)
+    # every named loss is elementwise-then-_masked_mean so a pad-and-mask
+    # feed's zero rows contribute nothing (mask=None reduces identically
+    # to the plain mean)
+    def mse(preds, labels, mask=None):
+        return _masked_mean((preds - labels) ** 2, mask)
 
-    def mae(preds, labels):
-        return jnp.mean(jnp.abs(preds - labels))
+    def mae(preds, labels, mask=None):
+        return _masked_mean(jnp.abs(preds - labels), mask)
 
-    def smooth_l1(preds, labels, beta=1.0):
+    def smooth_l1(preds, labels, beta=1.0, mask=None):
         # parity: the reference's NYCTaxi example trains with SmoothL1Loss
         # (examples/pytorch_nyctaxi.py:69-105)
         d = jnp.abs(preds - labels)
-        return jnp.mean(jnp.where(d < beta, 0.5 * d * d / beta, d - 0.5 * beta))
+        return _masked_mean(jnp.where(d < beta, 0.5 * d * d / beta,
+                                      d - 0.5 * beta), mask)
 
-    def bce_with_logits(logits, labels):
-        return jnp.mean(jnp.clip(logits, 0) - logits * labels
-                        + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    def bce_with_logits(logits, labels, mask=None):
+        return _masked_mean(jnp.clip(logits, 0) - logits * labels
+                            + jnp.log1p(jnp.exp(-jnp.abs(logits))), mask)
 
-    def softmax_cross_entropy(logits, labels):
+    def softmax_cross_entropy(logits, labels, mask=None):
         import optax
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, labels.astype(jnp.int32)).mean()
+        return _masked_mean(optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels.astype(jnp.int32)), mask)
 
     table = {"mse": mse, "l2": mse, "mae": mae, "l1": mae,
              "smooth_l1": smooth_l1, "huber": smooth_l1,
@@ -111,6 +128,40 @@ def _resolve_loss(loss) -> Callable:
     if name not in table:
         raise ValueError(f"unknown loss {name!r}; have {sorted(table)}")
     return table[name]
+
+
+def _loss_takes_mask(loss) -> bool:
+    """Can this loss spec weight out padded rows? Named losses all can; a
+    user callable must accept a ``mask`` kwarg — otherwise the feed falls
+    back to dropping the tail (never silently mis-averaging pad zeros)."""
+    if not callable(loss):
+        return True
+    import inspect
+
+    try:
+        return "mask" in inspect.signature(loss).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+def _strip_mask(batch):
+    """Split the feed's validity mask off a batch dict (None when the feed
+    is not padding) — model/preprocessor code never sees the mask key."""
+    from raydp_tpu.data.feed import MASK_KEY
+
+    mask = batch.get(MASK_KEY)
+    if mask is None:
+        return batch, None
+    return {k: v for k, v in batch.items() if k != MASK_KEY}, mask
+
+
+def _update_metric(m, stats, preds, labels, mask):
+    """Metric update with the mask passed ONLY when one exists: builtin
+    metrics take it; a custom Metric without mask support keeps working on
+    unpadded feeds and fails loudly (not silently wrong) on padded ones."""
+    if mask is None:
+        return m.update(stats, preds, labels)
+    return m.update(stats, preds, labels, mask=mask)
 
 
 class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
@@ -229,6 +280,16 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         columns = self._columns()
         ckpt_dir = self.checkpoint_dir or tempfile.mkdtemp(prefix="rdt-ckpt-")
 
+        # pad-and-mask rule, decided HERE for every feed below so train and
+        # eval cannot disagree: under a >1 data extent a ragged tail pads to
+        # a full (shardable) batch and carries a validity mask instead of
+        # silently dropping rows. RDT_TRAIN_PAD_TAIL=0 — or a custom loss
+        # with no mask kwarg — restores the drop behavior.
+        from raydp_tpu.parallel.mesh import data_axes
+        dp_total = int(np.prod([mesh.shape[a] for a in data_axes(mesh)]))
+        pad_tail = (dp_total > 1 and bool(knobs.get("RDT_TRAIN_PAD_TAIL"))
+                    and _loss_takes_mask(self._loss))
+
         # device-resident fast path: dataset pinned in HBM, whole epoch in one
         # jitted dispatch with on-device shuffling (falls back to the
         # streaming feed when too large / multi-process / ragged-batch)
@@ -240,16 +301,15 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
             feed = DeviceFeed(train_ds, self.batch_size, columns, mesh=mesh,
                               shuffle=self.shuffle, seed=self.seed,
                               drop_remainder=self.drop_last,
+                              pad_remainder=pad_tail and not self.drop_last,
                               prefetch_to_device=self.prefetch_to_device)
         eval_feed = eval_cache = None
         eval_tail_ok = False
         if evaluate_ds is not None:
-            # a ragged final batch cannot shard over a >1 data axis; drop it
-            # there (static shapes also avoid one extra XLA compile)
-            from raydp_tpu.parallel.mesh import data_axes
-            dp_total = int(np.prod([mesh.shape[a] for a in data_axes(mesh)]))
-            eval_tail_ok = dp_total == 1  # the tail-batch rule, decided HERE
-            # beside the drop_remainder rule so the two cannot disagree
+            # the ragged final batch: fine as-is under a size-1 data extent,
+            # pad-and-masked under a >1 one (dropped only when padding is
+            # opted out — the pre-PR-16 behavior)
+            eval_tail_ok = dp_total == 1 or pad_tail
             # eval goes resident alongside the train set: the whole eval
             # pass becomes one scan dispatch (+ one for the ragged tail)
             # instead of one dispatch per batch, every epoch. The budget is
@@ -264,11 +324,13 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 eval_feed = DeviceFeed(evaluate_ds, self.batch_size, columns,
                                        mesh=mesh, shuffle=False,
                                        drop_remainder=dp_total > 1,
+                                       pad_remainder=pad_tail,
                                        prefetch_to_device=self.prefetch_to_device)
 
         state, history = self._train_loop(
             mesh, feed, eval_feed, ckpt_dir, max_retries=max_retries,
-            cache=cache, eval_cache=eval_cache, eval_tail_ok=eval_tail_ok)
+            cache=cache, eval_cache=eval_cache, eval_tail_ok=eval_tail_ok,
+            eval_tail_pad=pad_tail)
         self._result = TrainingResult(state=state, history=history,
                                       checkpoint_dir=ckpt_dir)
         return self._result
@@ -282,7 +344,8 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
     def _train_loop(self, mesh, feed, eval_feed, ckpt_dir: str,
                     max_retries: int = 0, resume: bool = False, cache=None,
-                    eval_cache=None, eval_tail_ok: bool = False):
+                    eval_cache=None, eval_tail_ok: bool = False,
+                    eval_tail_pad: bool = False):
         import jax
         import jax.numpy as jnp
         import optax
@@ -319,7 +382,16 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
         shardings_of = param_sharding_rules(mesh, self.param_rules)
         state_sharding = shardings_of(state)
-        state = self._place_state(state, state_sharding)
+        from raydp_tpu import metrics as rdt_metrics
+        from raydp_tpu import profiler
+        from raydp_tpu.parallel.roles import addressable_nbytes
+        with profiler.trace("train:place", "training"):
+            state = self._place_state(state, state_sharding)
+        # the fsdp memory claim, observed where it is true: bytes of params
+        # + optimizer state resident on THIS process's devices after
+        # placement (replicated leaves count one copy per device)
+        rdt_metrics.set_gauge("train_param_bytes_per_process",
+                            addressable_nbytes(state))
         b_sharding = batch_sharding(mesh)
 
         compute_dtype = self.compute_dtype
@@ -354,10 +426,14 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         # in-jit accumulation the only host reads are float() of replicated
         # scalars at epoch end (also one fewer host sync single-process).
         def train_step(state, batch, mstats, loss_sum):
+            batch, mask = _strip_mask(batch)
+
             def _loss(params):
                 preds, labels, new_bstats = _apply(
                     params, state.batch_stats, batch, train=True)
-                return loss_fn(preds, labels), (preds, new_bstats)
+                lv = loss_fn(preds, labels, mask=mask) if mask is not None \
+                    else loss_fn(preds, labels)
+                return lv, (preds, new_bstats)
 
             (loss_val, (preds, new_bstats)), grads = jax.value_and_grad(
                 _loss, has_aux=True)(state.params)
@@ -366,19 +442,32 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 new_state = new_state.replace(batch_stats=new_bstats)
             _, labels = split_batch(batch)
             new_mstats = tuple(
-                m.update(s, preds, labels) for m, s in zip(metrics, mstats))
+                _update_metric(m, s, preds, labels, mask)
+                for m, s in zip(metrics, mstats))
             return new_state, loss_sum + loss_val.astype(jnp.float32), new_mstats
 
-        def eval_step(state, batch, mstats, loss_sum):
+        # eval threads BOTH accumulators (row-weighted loss sum AND the row
+        # count) through the jitted step: under pad-and-mask the real row
+        # count is mask.sum(), known on device — a host-side shape[0] count
+        # would bill padded rows into the eval mean
+        def eval_step(state, batch, mstats, loss_sum, cnt_sum):
+            batch, mask = _strip_mask(batch)
             preds, labels, _ = _apply(state.params, state.batch_stats, batch,
                                       train=False)
-            loss_val = loss_fn(preds, labels).astype(jnp.float32)
+            if mask is None:
+                rows = jnp.float32(labels.shape[0])
+                loss_val = loss_fn(preds, labels).astype(jnp.float32)
+            else:
+                rows = jnp.sum(mask)
+                loss_val = loss_fn(preds, labels,
+                                   mask=mask).astype(jnp.float32)
             new_mstats = tuple(
-                m.update(s, preds, labels) for m, s in zip(metrics, mstats))
-            return loss_sum + loss_val * labels.shape[0], new_mstats
+                _update_metric(m, s, preds, labels, mask)
+                for m, s in zip(metrics, mstats))
+            return loss_sum + loss_val * rows, cnt_sum + rows, new_mstats
 
         jit_train = jax.jit(train_step, donate_argnums=(0, 3))
-        jit_eval = jax.jit(eval_step, donate_argnums=(3,))
+        jit_eval = jax.jit(eval_step, donate_argnums=(3, 4))
 
         chain = self.steps_per_dispatch
         jit_chain = None
@@ -416,30 +505,40 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
         jit_eval_epoch = None
         eval_tail = None
-        eval_cache_rows = 0
         if eval_cache is not None:
             # the whole eval pass as ONE scan dispatch, built by the same
             # make_epoch_fn as the train scan (one source for the
             # slice/constraint/scan logic); the ragged tail travels as one
-            # extra jitted call where a single data shard allows it
-            # (matching the streaming feed's drop-remainder rule, decided
-            # in fit() as eval_tail_ok). The carry rides the state through
-            # unchanged — NOT donated (it lives on into the next epoch)
+            # extra jitted call — as-is where a single data shard allows it,
+            # zero-padded to a full batch with a validity mask under a >1
+            # data extent (eval_tail_ok/eval_tail_pad, decided in fit()
+            # beside the streaming feed's rule so the two cannot disagree).
+            # The carry rides the state through unchanged — NOT donated (it
+            # lives on into the next epoch)
             def _eval_scan_step(carry, batch):
-                state, estats, esum = carry
-                esum, estats = eval_step(state, batch, estats, esum)
-                return state, estats, esum
+                state, estats, esum, ecnt = carry
+                esum, ecnt, estats = eval_step(state, batch, estats, esum,
+                                               ecnt)
+                return state, estats, esum, ecnt
 
             eval_epoch_fn, esteps = eval_cache.make_epoch_fn(
                 _eval_scan_step, self.batch_size, shuffle=False,
                 batch_sharding=b_sharding)
             jit_eval_epoch = jax.jit(eval_epoch_fn)
-            eval_cache_rows = esteps * self.batch_size
-            tail_rows = eval_cache.num_rows - eval_cache_rows
+            tail_off = esteps * self.batch_size
+            tail_rows = eval_cache.num_rows - tail_off
             if tail_rows > 0 and eval_tail_ok:
-                eval_tail = {n: a[eval_cache_rows:]
+                eval_tail = {n: a[tail_off:]
                              for n, a in eval_cache.arrays.items()}
-                eval_cache_rows += tail_rows
+                if eval_tail_pad:
+                    from raydp_tpu.data.feed import MASK_KEY
+                    pad = self.batch_size - tail_rows
+                    eval_tail = {
+                        n: jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+                        for n, a in eval_tail.items()}
+                    eval_tail[MASK_KEY] = (
+                        jnp.arange(self.batch_size) < tail_rows
+                    ).astype(jnp.float32)
 
         history: List[Dict[str, float]] = []
         epoch = 0
@@ -541,21 +640,20 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 if eval_feed is not None or eval_cache is not None:
                     estats = tuple(m.init() for m in metrics)
                     esum = np.zeros((), np.float32)
+                    ecnt = np.zeros((), np.float32)
                     if eval_cache is not None:
-                        ecnt = eval_cache_rows
-                        _, estats, esum = jit_eval_epoch(
-                            (state, estats, esum), eval_cache.arrays,
+                        _, estats, esum, ecnt = jit_eval_epoch(
+                            (state, estats, esum, ecnt), eval_cache.arrays,
                             jax.random.PRNGKey(0))  # unused: shuffle=False
                         if eval_tail is not None:
-                            esum, estats = jit_eval(state, eval_tail,
-                                                    estats, esum)
+                            esum, ecnt, estats = jit_eval(
+                                state, eval_tail, estats, esum, ecnt)
                     else:
-                        ecnt = 0  # exact host-side int (static shapes)
                         for batch in eval_feed:
-                            ecnt += int(next(iter(batch.values())).shape[0])
-                            esum, estats = jit_eval(state, batch, estats,
-                                                    esum)
-                    report["eval_loss"] = (float(esum) / ecnt) if ecnt \
+                            esum, ecnt, estats = jit_eval(state, batch,
+                                                          estats, esum, ecnt)
+                    rows = float(ecnt)  # real rows only: pad rows mask to 0
+                    report["eval_loss"] = (float(esum) / rows) if rows \
                         else float("nan")
                     for m, s in zip(metrics, estats):
                         report[f"eval_{m.name}"] = m.compute(
@@ -645,6 +743,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
             self._online = o
         feed = DeviceFeed(ds, self.batch_size, o["columns"], mesh=o["mesh"],
                           shuffle=False, drop_remainder=o["drop_last"],
+                          pad_remainder=o["pad_tail"],
                           prefetch_to_device=self.prefetch_to_device)
         t0 = _time.perf_counter()
         mstats = tuple(m.init() for m in self._metrics)
@@ -719,6 +818,8 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         split_batch = self._split_batch
 
         def train_step(state, batch, mstats, loss_sum):
+            batch, mask = _strip_mask(batch)
+
             def _loss(params):
                 inputs, labels = split_batch(batch)
                 inputs = _cast_floating(inputs, compute_dtype)
@@ -735,7 +836,9 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 if preds.ndim == labels.ndim + 1 and preds.shape[-1] == 1:
                     preds = preds.squeeze(-1)
                 preds = preds.astype(jnp.float32)
-                return loss_fn(preds, labels), (preds, new_bstats)
+                lv = loss_fn(preds, labels, mask=mask) if mask is not None \
+                    else loss_fn(preds, labels)
+                return lv, (preds, new_bstats)
 
             (loss_val, (preds, new_bstats)), grads = jax.value_and_grad(
                 _loss, has_aux=True)(state.params)
@@ -744,21 +847,25 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 new_state = new_state.replace(batch_stats=new_bstats)
             _, labels = split_batch(batch)
             new_mstats = tuple(
-                m.update(s, preds, labels) for m, s in zip(metrics, mstats))
+                _update_metric(m, s, preds, labels, mask)
+                for m, s in zip(metrics, mstats))
             return (new_state, loss_sum + loss_val.astype(jnp.float32),
                     new_mstats)
 
         dp_total = int(np.prod([mesh.shape[a] for a in data_axes(mesh)]))
+        # the ragged micro-batch tail under a >1 data extent: pad-and-mask
+        # like fit()'s feeds (an online epoch is often SMALLER than one
+        # batch — dropping its tail silently skipped whole micro-batches);
+        # RDT_TRAIN_PAD_TAIL=0 or a mask-blind custom loss restores drop
+        pad_tail = (dp_total > 1 and bool(knobs.get("RDT_TRAIN_PAD_TAIL"))
+                    and _loss_takes_mask(self._loss))
         return {
             "mesh": mesh,
             "columns": columns,
             "state": state,
             "jit_train": jax.jit(train_step, donate_argnums=(0, 3)),
-            # a ragged micro-batch tail cannot shard over a >1 data axis
-            # (the eval-feed rule in fit), so it drops there; a size-1 data
-            # extent trains every row — dropping an online epoch's tail
-            # would silently skip whole small micro-batches
-            "drop_last": dp_total > 1,
+            "drop_last": dp_total > 1 and not pad_tail,
+            "pad_tail": pad_tail,
             "history": [],
         }
 

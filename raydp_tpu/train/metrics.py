@@ -15,13 +15,25 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _row_weights(labels, mask):
+    """Per-ELEMENT weights from a per-row 0/1 mask (pad-and-mask feeds):
+    broadcast the mask over the label's trailing dims so a padded row's
+    elements weigh 0 in both the statistic sum and the count. ``mask=None``
+    weighs every element 1 — the pre-mask semantics exactly."""
+    if mask is None:
+        return jnp.ones_like(labels, dtype=jnp.float32)
+    return jnp.broadcast_to(
+        mask.reshape((-1,) + (1,) * (labels.ndim - 1)),
+        labels.shape).astype(jnp.float32)
+
+
 class Metric:
     name: str = "metric"
 
     def init(self) -> Dict[str, float]:
         return {"sum": 0.0, "count": 0.0}
 
-    def update(self, stats, preds, labels):
+    def update(self, stats, preds, labels, mask=None):
         raise NotImplementedError
 
     def compute(self, stats) -> float:
@@ -31,9 +43,11 @@ class Metric:
 class MSE(Metric):
     name = "mse"
 
-    def update(self, stats, preds, labels):
-        err = jnp.sum((preds - labels) ** 2)
-        return {"sum": stats["sum"] + err, "count": stats["count"] + labels.size}
+    def update(self, stats, preds, labels, mask=None):
+        w = _row_weights(labels, mask)
+        err = jnp.sum(((preds - labels) ** 2) * w)
+        return {"sum": stats["sum"] + err,
+                "count": stats["count"] + jnp.sum(w)}
 
 
 class RMSE(MSE):
@@ -46,30 +60,41 @@ class RMSE(MSE):
 class MAE(Metric):
     name = "mae"
 
-    def update(self, stats, preds, labels):
-        err = jnp.sum(jnp.abs(preds - labels))
-        return {"sum": stats["sum"] + err, "count": stats["count"] + labels.size}
+    def update(self, stats, preds, labels, mask=None):
+        w = _row_weights(labels, mask)
+        err = jnp.sum(jnp.abs(preds - labels) * w)
+        return {"sum": stats["sum"] + err,
+                "count": stats["count"] + jnp.sum(w)}
 
 
 class Accuracy(Metric):
     name = "accuracy"
 
-    def update(self, stats, preds, labels):
+    def update(self, stats, preds, labels, mask=None):
         if preds.ndim > labels.ndim:
             pred_cls = jnp.argmax(preds, axis=-1)
         else:
             pred_cls = (preds > 0.5).astype(jnp.int32)
-        hits = jnp.sum((pred_cls == labels.astype(pred_cls.dtype)).astype(jnp.float32))
-        return {"sum": stats["sum"] + hits, "count": stats["count"] + labels.shape[0]}
+        hits = (pred_cls == labels.astype(pred_cls.dtype)).astype(jnp.float32)
+        if mask is not None:
+            hits = hits * mask
+            rows = jnp.sum(mask)
+        else:
+            rows = labels.shape[0]
+        return {"sum": stats["sum"] + jnp.sum(hits),
+                "count": stats["count"] + rows}
 
 
 class BinaryCrossEntropy(Metric):
     name = "bce"
 
-    def update(self, stats, preds, labels):
+    def update(self, stats, preds, labels, mask=None):
+        w = _row_weights(labels, mask)
         p = jnp.clip(preds, 1e-7, 1 - 1e-7)
-        ll = -jnp.sum(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p))
-        return {"sum": stats["sum"] + ll, "count": stats["count"] + labels.size}
+        ll = -jnp.sum((labels * jnp.log(p)
+                       + (1 - labels) * jnp.log(1 - p)) * w)
+        return {"sum": stats["sum"] + ll,
+                "count": stats["count"] + jnp.sum(w)}
 
 
 _REGISTRY = {m.name: m for m in (MSE(), RMSE(), MAE(), Accuracy(),

@@ -33,7 +33,7 @@ def _linear_df(session, n=1536, parts=4):
     return session.createDataFrame(pdf, num_partitions=parts)
 
 
-def _mlp_estimator(mesh_spec=None, num_epochs=3, ckpt_dir=None):
+def _mlp_estimator(mesh_spec=None, num_epochs=3, ckpt_dir=None, **kw):
     import optax
 
     return FlaxEstimator(
@@ -47,7 +47,18 @@ def _mlp_estimator(mesh_spec=None, num_epochs=3, ckpt_dir=None):
         mesh_spec=mesh_spec,
         shuffle=False,
         checkpoint_dir=ckpt_dir,
+        **kw,
     )
+
+
+def _single_device_mesh():
+    """A 1-device mesh: the unsharded ground truth every mesh shape must
+    reproduce (SPMD sharding is a layout, not a math change)."""
+    import jax
+
+    from raydp_tpu.parallel import make_mesh
+
+    return make_mesh(MeshSpec(), devices=jax.devices()[:1])
 
 
 def test_process_local_batch_rows_single_process():
@@ -197,3 +208,219 @@ def test_gang_expert_sharded_dlrm(session, tmp_path):
     emb2 = np.asarray(gang.get_model()["params"]["embedding_0"]["embedding"])
     assert emb2.shape == emb1.shape
     np.testing.assert_allclose(emb2, emb1, rtol=1e-3, atol=1e-4)
+
+
+# ---- single-process mesh matrix (8 virtual devices, PR 16) ------------------
+# The role policy + pad-and-mask feed path, exercised where the container
+# can run them: one process, 8 virtual CPU devices. The 2-process tests
+# above cover the cross-process variants of the same machinery.
+
+
+def test_role_policy_classify_and_specs():
+    """The SpecLayout-style role classifier: path+shape → role → spec."""
+    from jax.sharding import PartitionSpec as P
+
+    from raydp_tpu.parallel import make_mesh
+    from raydp_tpu.parallel.roles import classify_param, role_partition_spec
+
+    assert classify_param("params/embedding_0/embedding", (32, 8)) \
+        == "embedding"
+    assert classify_param("params/Dense_0/kernel", (16, 8)) == "kernel"
+    assert classify_param("params/Dense_0/bias", (8,)) == "replicated"
+    # optimizer-state mirrors classify like the parameter itself
+    assert classify_param("opt_state/0/mu/Dense_0/kernel", (16, 8)) \
+        == "kernel"
+
+    mesh = make_mesh(dict(fsdp=4, tensor=2))
+    # embedding rows span fsdp×tensor when the product divides the vocab
+    assert role_partition_spec(mesh, "params/embed/embedding", (32, 8)) \
+        == P(("fsdp", "tensor"), None)
+    # kernels: tensor on the output dim, fsdp on the largest remaining
+    assert role_partition_spec(mesh, "params/Dense_0/kernel", (16, 8)) \
+        == P("fsdp", "tensor")
+    # ≤1-D replicates; indivisible dims degrade axis by axis, never raise
+    assert role_partition_spec(mesh, "params/Dense_0/bias", (8,)) == P()
+    assert role_partition_spec(mesh, "params/Dense_0/kernel", (3, 5)) \
+        == P(None, None)
+    # tensor-only fit on the vocab when fsdp does not divide
+    mesh2 = make_mesh(dict(fsdp=4, tensor=2))
+    assert role_partition_spec(mesh2, "params/embed/embedding", (6, 4)) \
+        == P("tensor", None)
+
+
+def test_optimizer_state_inherits_param_specs():
+    """Adam moments mirror the parameter paths/shapes, so the role policy
+    shards them identically — the FSDP memory win covers the optimizer."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from flax.training import train_state
+
+    from raydp_tpu.parallel import make_mesh, param_sharding_rules
+
+    mesh = make_mesh(dict(fsdp=4, tensor=2))
+    model = MLP(features=(32, 16), use_batch_norm=False)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 2)))
+    state = train_state.TrainState.create(
+        apply_fn=model.apply, params=variables["params"],
+        tx=optax.adam(1e-3))
+    sh = param_sharding_rules(mesh, None)(state)
+    mu = sh.opt_state[0].mu
+    p_leaves = jax.tree.leaves(sh.params)
+    m_leaves = jax.tree.leaves(mu)
+    assert len(p_leaves) == len(m_leaves)
+    for p_s, m_s in zip(p_leaves, m_leaves):
+        assert p_s.spec == m_s.spec
+    # at least one kernel actually sharded (the policy is not a no-op here)
+    assert any(tuple(s.spec) for s in p_leaves)
+
+
+def test_mesh_equivalence_matrix(session):
+    """dp / fsdp / fsdp×tp from mesh_spec alone (no param_rules): per-epoch
+    losses match the single-device run — sharding changes the layout, not
+    the math. Also the dict-valued mesh_spec path."""
+    from raydp_tpu.data.dataset import from_frame
+
+    ds = from_frame(_linear_df(session))
+    base = _mlp_estimator(mesh=_single_device_mesh())
+    losses0 = [h["train_loss"] for h in base.fit(ds).history]
+
+    for spec in (MeshSpec(), MeshSpec(fsdp=8), dict(fsdp=4, tensor=2)):
+        est = _mlp_estimator(mesh_spec=spec)
+        r = est.fit(ds)
+        np.testing.assert_allclose(
+            [h["train_loss"] for h in r.history], losses0, rtol=5e-4,
+            err_msg=f"mesh_spec={spec}")
+
+    # the last (fsdp=4 × tensor=2) state is really sharded by role:
+    # Dense_1 kernel (32, 16) → fsdp on the input dim, tensor on the output
+    from jax.sharding import PartitionSpec as P
+
+    k = est.get_state().params["Dense_1"]["kernel"]
+    assert k.sharding.spec == P("fsdp", "tensor")
+
+
+def test_train_ragged_tail_pad_parity(session):
+    """drop_last=False with a 28-row tail (1500 = 23×64 + 28): under an
+    8-way data extent the tail pads-and-masks to a full batch — same step
+    count and same per-epoch losses as the single-device run that consumes
+    the ragged batch natively. Before PR 16 this config could not even
+    place the tail (28 rows do not divide over 8 devices)."""
+    from raydp_tpu.data.dataset import from_frame
+
+    ds = from_frame(_linear_df(session, n=1500))
+
+    base = _mlp_estimator(mesh=_single_device_mesh(), drop_last=False)
+    r0 = base.fit(ds)
+    assert [h["steps"] for h in r0.history] == [24, 24, 24]
+
+    sharded = _mlp_estimator(mesh_spec=MeshSpec(fsdp=8), drop_last=False)
+    r1 = sharded.fit(ds)
+    assert [h["steps"] for h in r1.history] == [24, 24, 24]
+    np.testing.assert_allclose(
+        [h["train_loss"] for h in r1.history],
+        [h["train_loss"] for h in r0.history], rtol=5e-4)
+
+
+def test_eval_ragged_tail_pad_parity(session, monkeypatch):
+    """The eval tail (300 = 4×64 + 44) is padded-and-masked instead of
+    dropped under a >1 data extent, on BOTH eval paths: the device-resident
+    scan (tail padded in-jit) and the streaming feed (tail padded on the
+    host). eval_loss must match the single-device run exactly because the
+    mask keeps padded rows out of the loss AND the row count."""
+    from raydp_tpu.data.dataset import from_frame
+
+    train = from_frame(_linear_df(session, n=1024))
+    ev = from_frame(_linear_df(session, n=300, parts=2))
+
+    base = _mlp_estimator(mesh=_single_device_mesh(), metrics=["mae"])
+    e0 = base.fit(train, ev).history[-1]
+
+    # device-resident eval cache: the ragged tail pads inside the jit
+    cached = _mlp_estimator(mesh_spec=MeshSpec(fsdp=8), metrics=["mae"])
+    e1 = cached.fit(train, ev).history[-1]
+    np.testing.assert_allclose(e1["eval_loss"], e0["eval_loss"], rtol=5e-4)
+    np.testing.assert_allclose(e1["eval_mae"], e0["eval_mae"], rtol=5e-4)
+
+    # streaming eval feed: pad_batch on the host side of the prefetcher
+    monkeypatch.setenv("RDT_DEVICE_CACHE", "0")
+    streamed = _mlp_estimator(mesh_spec=MeshSpec(fsdp=8), metrics=["mae"])
+    e2 = streamed.fit(train, ev).history[-1]
+    np.testing.assert_allclose(e2["eval_loss"], e0["eval_loss"], rtol=5e-4)
+    np.testing.assert_allclose(e2["eval_mae"], e0["eval_mae"], rtol=5e-4)
+
+
+def test_pad_tail_knob_restores_drop(session, monkeypatch):
+    """RDT_TRAIN_PAD_TAIL=0 is the escape hatch back to the pre-PR-16 drop:
+    a 40-row online epoch under fsdp=8 (batch 64) then yields no step at
+    all, where padding turns it into one masked step."""
+    from raydp_tpu.data.dataset import from_frame
+
+    ds = from_frame(_linear_df(session, n=40, parts=2))
+
+    est = _mlp_estimator(mesh_spec=MeshSpec(fsdp=8))
+    r1 = est._partial_fit_epoch(ds, 0)
+    assert r1["steps"] == 1
+    assert np.isfinite(r1["train_loss"])
+
+    monkeypatch.setenv("RDT_TRAIN_PAD_TAIL", "0")
+    est2 = _mlp_estimator(mesh_spec=MeshSpec(fsdp=8))
+    r2 = est2._partial_fit_epoch(ds, 0)
+    assert r2["steps"] == 0
+
+
+def test_checkpoint_roundtrip_across_mesh_shapes(session, tmp_path):
+    """Train under fsdp=2, restore the checkpoint into a dp-only mesh:
+    restore_placed reassembles full values under the NEW shardings — a
+    topology change between save and restore is routine (autoscale)."""
+    import jax
+
+    from raydp_tpu.data.dataset import from_frame
+    from raydp_tpu.parallel import make_mesh, param_sharding_rules
+    from raydp_tpu.train import checkpoint as ckpt
+
+    ds = from_frame(_linear_df(session, n=1024))
+    ckpt_dir = str(tmp_path / "ck")
+    est = _mlp_estimator(mesh_spec=dict(fsdp=2), num_epochs=2,
+                         ckpt_dir=ckpt_dir)
+    est.fit(ds)
+    trained = est.get_state()
+
+    dp_mesh = make_mesh(MeshSpec())  # data=8: every param replicated
+    shardings = param_sharding_rules(dp_mesh, None)(trained)
+    restored, step = ckpt.restore_placed(ckpt_dir, trained, shardings)
+    assert step == 1
+    for a, b in zip(jax.tree.leaves(trained), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and the restored tree really lives under the dp mesh's shardings
+    from jax.sharding import PartitionSpec as P
+
+    k = restored.params["Dense_1"]["kernel"]
+    assert k.sharding.mesh.shape["fsdp"] == 1
+    assert k.sharding.spec == P()
+
+
+def test_sharded_export_serve_bitwise_matches_predict(session, tmp_path):
+    """export_serving off an fsdp×tp-trained state → load_servable →
+    predict_table is bit-identical to the estimator's own predict: the
+    export gathered exactly the trained weights."""
+    import pyarrow as pa
+
+    from raydp_tpu.data.dataset import from_frame
+    from raydp_tpu.serve.servable import load_servable
+
+    rng = np.random.RandomState(0)
+    x = rng.random_sample((512, 2))
+    y = x @ np.array([2.0, -3.0]) + 1.0
+    pdf = pd.DataFrame({"x1": x[:, 0], "x2": x[:, 1], "y": y})
+    df = session.createDataFrame(pdf, num_partitions=2)
+    ds = from_frame(df)
+
+    est = _mlp_estimator(mesh_spec=dict(fsdp=4, tensor=2), num_epochs=2)
+    est.fit(ds)
+    ref = est.predict(from_frame(df.select("x1", "x2")))
+
+    sv = load_servable(est.export_serving(str(tmp_path / "bundle")))
+    got = sv.predict_table(pa.table({"x1": pdf["x1"].values,
+                                     "x2": pdf["x2"].values}))
+    assert np.array_equal(got, ref)
