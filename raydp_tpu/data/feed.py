@@ -465,7 +465,7 @@ class DeviceEpochCache:
         self.init_row = {n: a[:1].copy() for n, a in joined.items()}
 
     def make_epoch_fn(self, step, batch_size: int, shuffle: bool,
-                      batch_sharding=None):
+                      batch_sharding=None, seq_sharding=None):
         """Build THE resident epoch program both estimators jit — one source
         for the permutation/slice/constraint/scan logic so the flax and keras
         twins cannot drift.
@@ -475,7 +475,9 @@ class DeviceEpochCache:
         ``epoch_fn(carry, data, key) -> carry``: one whole epoch —
         per-epoch on-device permutation when ``shuffle`` (a true uniform row
         shuffle), batches sliced/gathered on device, each constrained onto
-        the mesh's batch sharding. Callers jit it with the carry donated and
+        the mesh's batch sharding — ndim >= 2 leaves onto ``seq_sharding``
+        when one is given, so declared sequence dims spread over the mesh's
+        ``seq`` axis. Callers jit it with the carry donated and
         ``data``/``key`` left alone (the resident arrays are reused every
         epoch).
         """
@@ -498,8 +500,15 @@ class DeviceEpochCache:
                     batch = {n: lax.dynamic_slice_in_dim(a, s * B, B, 0)
                              for n, a in data.items()}
                 if batch_sharding is not None:
-                    batch = lax.with_sharding_constraint(batch,
-                                                         batch_sharding)
+                    if seq_sharding is not None:
+                        batch = {
+                            n: lax.with_sharding_constraint(
+                                a, seq_sharding if a.ndim >= 2
+                                else batch_sharding)
+                            for n, a in batch.items()}
+                    else:
+                        batch = lax.with_sharding_constraint(batch,
+                                                             batch_sharding)
                 return step(carry, batch), ()
 
             carry, _ = lax.scan(body, carry, jnp.arange(steps_per_epoch))
@@ -749,6 +758,7 @@ class DeviceFeed:
         host_iter=None,
         prefetch_to_device: Optional[int] = None,
         pad_remainder: bool = False,
+        seq: bool = False,
     ):
         import jax
         self._jax = jax
@@ -766,6 +776,11 @@ class DeviceFeed:
         self.prefetch_to_device = max(0, int(prefetch_to_device))
         self.timings = PipelineTimings()
         self._shardings = None
+        #: seq-extended sharding for ndim >= 2 batch leaves (None when the
+        #: mesh has no >1 ``seq`` extent or the caller left ``seq`` off):
+        #: declared sequence dims stage onto the ``seq`` axis at placement,
+        #: so long-context activations never land whole on one device
+        self._seq_sharding = None
         if mesh is not None:
             if data_axis is None:
                 # the batch's true sharding spans data AND fsdp axes; using
@@ -773,8 +788,10 @@ class DeviceFeed:
                 # replicated sharding, and in gang mode each process would
                 # then assemble a DIFFERENT "replicated" array from its own
                 # rows — silently inconsistent global batches
-                from raydp_tpu.parallel.mesh import batch_sharding
+                from raydp_tpu.parallel.mesh import batch_sharding, seq_extent
                 self._sharding = batch_sharding(mesh)
+                if seq and seq_extent(mesh) > 1:
+                    self._seq_sharding = batch_sharding(mesh, seq=True)
             else:
                 from jax.sharding import NamedSharding, PartitionSpec
                 self._sharding = NamedSharding(mesh, PartitionSpec(data_axis))
@@ -787,17 +804,26 @@ class DeviceFeed:
             self._base_seed = self.host_iter.seed
         self.host_iter.seed = epoch_seed(self._base_seed, epoch + 1)
 
-    def _place(self, batch: Dict[str, np.ndarray], sharding=None):
+    def _place(self, batch: Dict[str, np.ndarray], sharding=None,
+               seq_sharding=None, min_seq_ndim: int = 2):
         jax = self._jax
-        sharding = sharding if sharding is not None else self._sharding
+        if sharding is None:
+            sharding, seq_sharding = self._sharding, self._seq_sharding
         if sharding is None:
             return {n: jax.device_put(a) for n, a in batch.items()}
+
+        def pick(a):
+            # only leaves with a dim past the batch axes carry a sequence
+            # dim (labels/masks are 1-D and keep the plain data sharding)
+            return seq_sharding if (seq_sharding is not None
+                                    and a.ndim >= min_seq_ndim) else sharding
+
         if jax.process_count() > 1:
             return {
-                n: jax.make_array_from_process_local_data(sharding, a)
+                n: jax.make_array_from_process_local_data(pick(a), a)
                 for n, a in batch.items()
             }
-        return {n: jax.device_put(a, sharding) for n, a in batch.items()}
+        return {n: jax.device_put(a, pick(a)) for n, a in batch.items()}
 
     def _host_batches(self):
         """Host batches decoded ``prefetch`` ahead on a background thread;
@@ -807,9 +833,9 @@ class DeviceFeed:
             self.host_iter, depth=self.prefetch, timings=self.timings,
             pull_key="decode", name="devicefeed-host"))
 
-    def _timed_place(self, batch, sharding=None):
+    def _timed_place(self, batch, sharding=None, **kw):
         t0 = time.perf_counter()
-        out = self._place(batch, sharding=sharding)
+        out = self._place(batch, sharding=sharding, **kw)
         self.timings.add("h2d", time.perf_counter() - t0)
         return out
 
@@ -845,11 +871,15 @@ class DeviceFeed:
             for batch in self:
                 yield batch, 1
             return
-        stacked_sharding = None
+        stacked_sharding = stacked_seq = None
         if self._sharding is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             stacked_sharding = NamedSharding(
                 self.mesh, PartitionSpec(None, *tuple(self._sharding.spec)))
+            if self._seq_sharding is not None:
+                stacked_seq = NamedSharding(
+                    self.mesh,
+                    PartitionSpec(None, *tuple(self._seq_sharding.spec)))
 
         def _rows(b: Dict[str, np.ndarray]) -> int:
             return next(iter(b.values())).shape[0]
@@ -878,6 +908,10 @@ class DeviceFeed:
 
         def _place_stack(item):
             stacked, n = item
-            return self._timed_place(stacked, sharding=stacked_sharding), n
+            # the stack dim shifts everything right: a seq dim now sits at
+            # axis 2, and a stacked 1-D label is ndim-2 — hence the 3 floor
+            return self._timed_place(stacked, sharding=stacked_sharding,
+                                     seq_sharding=stacked_seq,
+                                     min_seq_ndim=3), n
 
         yield from self._placed(_stacks(), _place_stack)

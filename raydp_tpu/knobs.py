@@ -203,6 +203,17 @@ _ALL = [
        "Pad-and-mask the ragged final batch under a >1 data extent: zero "
        "rows square the batch and a mask drops them from losses/metrics. "
        "0 restores the silent tail drop."),
+    _k("RDT_TRAIN_ACCUM_STEPS", "int", 1, PER_ACTION, "training",
+       "Gradient-accumulation microbatches per optimizer step: each global "
+       "batch splits into this many slices scanned through the forward/"
+       "backward before one update, dividing peak activation bytes by the "
+       "same factor. Must divide batch_size; the estimator accum_steps= "
+       "argument overrides."),
+    _k("RDT_TRAIN_REMAT", "str", "none", PER_ACTION, "training",
+       "Rematerialization policy for the train-step forward (jax.checkpoint "
+       "placement by role, parallel/roles.py): 'dots' keeps MXU products "
+       "(kernel/embedding contractions) and recomputes elementwise glue; "
+       "'full' recomputes everything; 'none' saves all residuals."),
     # ---- serving plane ------------------------------------------------------
     _k("RDT_SERVE_MAX_BATCH", "int", 64, PER_ACTION, "serving",
        "Micro-batch row cap: concurrent predict() requests coalesce into "

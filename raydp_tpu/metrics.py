@@ -224,6 +224,15 @@ _ALL_METRICS = [
     _m("train_padded_rows_total", COUNTER, "rows", "training",
        "Zero rows appended by pad-and-mask feeds to square a ragged final "
        "batch; each padded row is masked out of losses and metrics."),
+    _m("train_accum_steps", GAUGE, "1", "training",
+       "Gradient-accumulation microbatches per optimizer step this fit is "
+       "running with (1 = unaccumulated; the RDT_TRAIN_ACCUM_STEPS / "
+       "accum_steps= setting after validation)."),
+    _m("train_activation_bytes_per_process", GAUGE, "bytes", "training",
+       "Compiled peak temporary (activation) bytes of the train step on "
+       "this process's devices, read off XLA's memory_analysis — the "
+       "activation-residency measure accumulation/remat/seq-sharding "
+       "drive down."),
 ]
 
 METRICS: Dict[str, Metric] = {m.name: m for m in _ALL_METRICS}
@@ -289,6 +298,10 @@ _ALL_SPANS = [
        "Sharded placement of the train state onto the mesh (host → device "
        "under each leaf's PartitionSpec; covers the initial FSDP/TP scatter "
        "or replication)."),
+    _s("train:accum", "training",
+       "Compilation + activation-residency analysis of the accumulated "
+       "train step (the lax.scan over microbatches; covers the "
+       "memory_analysis read behind train_activation_bytes_per_process)."),
 ]
 
 SPANS: Dict[str, Span] = {s.name: s for s in _ALL_SPANS}

@@ -61,10 +61,11 @@ class Attention(nn.Module):
     dtype: Any = jnp.float32
 
     def _dispatch(self) -> str:
+        from raydp_tpu.parallel.mesh import seq_extent
+
         if self.attention != "auto":
             return self.attention
-        if (self.mesh is not None and "seq" in self.mesh.axis_names
-                and self.mesh.shape["seq"] > 1):
+        if self.mesh is not None and seq_extent(self.mesh) > 1:
             return "ring"
         return "flash" if jax.default_backend() == "tpu" else "dense"
 

@@ -111,10 +111,25 @@ def data_axes(mesh) -> Tuple[str, ...]:
                  and mesh.shape[a] > 1) or ("data",)
 
 
-def batch_sharding(mesh, extra_batch_axes: Sequence[str] = ()):
+def batch_sharding(mesh, extra_batch_axes: Sequence[str] = (),
+                   seq: bool = False):
+    """Sharding of a batch-leading array: dim 0 over the data axes (plus any
+    ``extra_batch_axes`` folded into the same dim). With ``seq=True`` and a
+    >1 ``seq`` extent, dim 1 — the sequence dim — additionally shards over
+    ``seq``, so long-context activations never materialize whole per device
+    (callers must only apply the seq form to ndim >= 2 arrays)."""
     from jax.sharding import NamedSharding, PartitionSpec
     axes = tuple(data_axes(mesh)) + tuple(extra_batch_axes)
-    return NamedSharding(mesh, PartitionSpec(axes if len(axes) > 1 else axes[0]))
+    entry = axes if len(axes) > 1 else axes[0]
+    if seq and seq_extent(mesh) > 1:
+        return NamedSharding(mesh, PartitionSpec(entry, "seq"))
+    return NamedSharding(mesh, PartitionSpec(entry))
+
+
+def seq_extent(mesh) -> int:
+    """Size of the mesh's ``seq`` axis (1 when absent) — the gate every
+    seq-sharding call site checks before extending specs past dim 0."""
+    return int(mesh.shape.get("seq", 1)) if "seq" in mesh.axis_names else 1
 
 
 def replicated(mesh):

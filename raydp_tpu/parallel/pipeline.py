@@ -133,16 +133,32 @@ def pipeline_apply(fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     dp = 1
     for a in daxes:
         dp *= mesh.shape[a]
-    if daxes and int(x_micro.shape[1]) % dp == 0:
+    mb = int(x_micro.shape[1])
+    pad = 0
+    if daxes and mb % dp != 0:
+        # microbatch not divisible by the data extent: pad zero rows up to
+        # the next divisible count and slice them back off the outputs —
+        # the pipeline stays dp-sharded instead of silently replicating
+        # every microbatch (the pre-r17 fallback). Padded rows are zeros;
+        # callers mask their loss rows the same way the feed's pad-and-mask
+        # tail does, and the outputs sliced off here never reach a loss.
+        pad = dp - mb % dp
+        widths = [(0, 0)] * x_micro.ndim
+        widths[1] = (0, pad)
+        x_micro = jnp.pad(x_micro, widths)
+        from raydp_tpu import metrics
+        metrics.inc("train_padded_rows_total", pad * n_micro)
+    if daxes:
         mspec = P(None, daxes if len(daxes) > 1 else daxes[0])
-    else:  # microbatch not divisible by the data extent: replicate it
+    else:  # single-device data extent: nothing to shard the rows over
         mspec = P()
     pspec = jax.tree.map(lambda _: P(stage_axis), stage_params)
     body = functools.partial(_pipeline_local, fn=fn, stage_axis=stage_axis,
                              n_micro=n_micro)
-    return shard_map(body, mesh=mesh,
-                     in_specs=(pspec, mspec), out_specs=mspec)(
-                         stage_params, x_micro)
+    out = shard_map(body, mesh=mesh,
+                    in_specs=(pspec, mspec), out_specs=mspec)(
+                        stage_params, x_micro)
+    return out[:, :mb] if pad else out
 
 
 def stage_params_leading_dim(stage_params) -> int:

@@ -99,6 +99,49 @@ def role_partition_spec(mesh, path: str, shape: Tuple[int, ...]):
     return PartitionSpec(*spec)
 
 
+#: the remat policy vocabulary (RDT_TRAIN_REMAT / FlaxEstimator remat=)
+REMAT_MODES = ("none", "dots", "full")
+
+
+def remat_policy(mode: str):
+    """The ``jax.checkpoint`` saveable policy for one remat mode — the
+    activation-side mirror of the parameter role policy above. Roles split
+    the forward's residuals the same way they split the weights:
+
+    - ``dots`` keeps the MXU-bound products — the outputs of kernel and
+      embedding contractions (:data:`KERNEL`/:data:`EMBEDDING` leaves are
+      exactly the operands of those dots) — and recomputes the cheap
+      elementwise glue (:data:`REPLICATED`-role bias adds, activations,
+      norms) in the backward;
+    - ``full`` saves nothing: every residual recomputes, trading the most
+      FLOPs for the smallest live-activation footprint;
+    - ``none`` returns None — the caller skips ``jax.checkpoint`` entirely
+      and XLA keeps all residuals (the fastest, fattest default).
+    """
+    import jax
+
+    if mode == "none":
+        return None
+    if mode == "dots":
+        return jax.checkpoint_policies.checkpoint_dots
+    if mode == "full":
+        return jax.checkpoint_policies.nothing_saveable
+    raise ValueError(
+        f"unknown remat mode {mode!r}: expected one of {REMAT_MODES}")
+
+
+def apply_remat(fn, mode: str):
+    """``fn`` wrapped in ``jax.checkpoint`` under ``mode``'s policy
+    (``none`` returns ``fn`` untouched). Applied to the train-step forward
+    so the whole per-microbatch activation set obeys the policy."""
+    import jax
+
+    policy = remat_policy(mode)
+    if policy is None:
+        return fn
+    return jax.checkpoint(fn, policy=policy)
+
+
 def describe_roles(tree) -> dict:
     """Debug/bench helper: path → (role, shape) for every leaf of ``tree``."""
     import jax

@@ -164,6 +164,150 @@ def _update_metric(m, stats, preds, labels, mask):
     return m.update(stats, preds, labels, mask=mask)
 
 
+def _make_apply(model, takes_train, split_batch, compute_dtype):
+    """Build THE forward used by fit's train/eval steps and partial_fit —
+    one source for the split/cast/mutable-batch-stats/squeeze policy, so the
+    online twin cannot drift from the epoch loop.
+
+    Returns ``apply_fn(params, bstats, batch, train) ->
+    (preds_f32, labels, new_bstats)``."""
+    import jax.numpy as jnp
+
+    def apply_fn(params, bstats, batch, train: bool):
+        inputs, labels = split_batch(batch)
+        inputs = _cast_floating(inputs, compute_dtype)
+        variables = {"params": params}
+        kwargs = {"train": train} if takes_train else {}
+        if bstats is not None:
+            variables["batch_stats"] = bstats
+            if train:
+                preds, updates = model.apply(
+                    variables, inputs, mutable=["batch_stats"], **kwargs)
+                new_bstats = updates["batch_stats"]
+            else:
+                preds = model.apply(variables, inputs, **kwargs)
+                new_bstats = bstats
+        else:
+            preds = model.apply(variables, inputs, **kwargs)
+            new_bstats = None
+        if preds.ndim == labels.ndim + 1 and preds.shape[-1] == 1:
+            preds = preds.squeeze(-1)
+        return preds.astype(jnp.float32), labels, new_bstats
+
+    return apply_fn
+
+
+def _make_train_step(apply_fn, loss_fn, metrics, accum: int, remat_mode: str,
+                     mb_shardings=None):
+    """Build the jitted train-step body shared by ``fit`` and
+    ``partial_fit``: one optimizer update from one global batch.
+
+    With ``accum > 1`` the batch reshapes to ``[accum, B/accum, ...]``
+    microbatches folded through a ``lax.scan``: per-microbatch grads, loss
+    and metric stats accumulate ROW-WEIGHTED (a masked microbatch — even an
+    all-pad one from a pad-and-mask tail — weighs in by its real rows), so
+    the single ``apply_gradients`` at the end reproduces the unaccumulated
+    update to float-summation-order tolerance while only ONE microbatch's
+    activations are ever live. ``remat_mode`` wraps the forward in
+    ``jax.checkpoint`` per :func:`raydp_tpu.parallel.roles.apply_remat`;
+    both knobs together are the activation-residency lever the
+    ``mesh_bench --activation`` record measures.
+
+    ``mb_shardings`` — optional ``(batch_sharding, seq_sharding)`` pair
+    (seq may be None) re-asserted on every microbatch inside the scan: the
+    ``[B, ...] → [accum, B/accum, ...]`` reshape breaks GSPMD sharding
+    propagation, and without the constraint XLA gathers each microbatch
+    onto every data shard — erasing most of the residency win the
+    accumulation exists for (measured 4× worse peak temp bytes on an 8-way
+    mesh). Leaf rule matches the feed's: ndim >= 2 leaves take the
+    seq-extended spec, 1-D leaves (labels, masks) the plain batch spec.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from raydp_tpu.parallel.roles import apply_remat
+
+    def _microbatch_grads(params, bstats, batch, mask):
+        def _loss(p):
+            preds, labels, new_bstats = apply_fn(p, bstats, batch, train=True)
+            lv = loss_fn(preds, labels, mask=mask) if mask is not None \
+                else loss_fn(preds, labels)
+            return lv, (preds, labels, new_bstats)
+
+        fwd = apply_remat(_loss, remat_mode)
+        return jax.value_and_grad(fwd, has_aux=True)(params)
+
+    def train_step(state, batch, mstats, loss_sum):
+        batch, mask = _strip_mask(batch)
+        if accum <= 1:
+            (loss_val, (preds, labels, new_bstats)), grads = \
+                _microbatch_grads(state.params, state.batch_stats, batch,
+                                  mask)
+            new_state = state.apply_gradients(grads=grads)
+            if new_bstats is not None:
+                new_state = new_state.replace(batch_stats=new_bstats)
+            new_mstats = tuple(
+                _update_metric(m, s, preds, labels, mask)
+                for m, s in zip(metrics, mstats))
+            return (new_state, loss_sum + loss_val.astype(jnp.float32),
+                    new_mstats)
+
+        def _split(a):
+            if a.shape[0] % accum:
+                raise ValueError(
+                    f"accum_steps={accum} does not divide the batch "
+                    f"dimension {a.shape[0]}")
+            return a.reshape((accum, a.shape[0] // accum) + a.shape[1:])
+
+        micro = jax.tree.map(_split, batch)
+        micro_mask = None if mask is None else _split(mask)
+        # grads/loss accumulate in f32 regardless of the param dtype: k-1
+        # additions in bf16 would lose exactly the low bits the parity
+        # contract (accum=k == accum=1 to tolerance) protects
+        g0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                          state.params)
+        ms0 = jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), mstats)
+
+        def body(carry, xs):
+            g_acc, l_acc, r_acc, bstats, ms = carry
+            mb = xs[0]
+            mb_mask = xs[1] if micro_mask is not None else None
+            if mb_shardings is not None:
+                b_sh, s_sh = mb_shardings
+                mb = jax.tree.map(
+                    lambda a: lax.with_sharding_constraint(
+                        a, s_sh if s_sh is not None and a.ndim >= 2
+                        else b_sh), mb)
+                if mb_mask is not None:
+                    mb_mask = lax.with_sharding_constraint(mb_mask, b_sh)
+            (lv, (preds, labels, new_bstats)), g = _microbatch_grads(
+                state.params, bstats, mb, mb_mask)
+            rows = jnp.sum(mb_mask) if mb_mask is not None \
+                else jnp.float32(labels.shape[0])
+            g_acc = jax.tree.map(
+                lambda a, gg: a + gg.astype(jnp.float32) * rows, g_acc, g)
+            l_acc = l_acc + lv.astype(jnp.float32) * rows
+            r_acc = r_acc + rows
+            ms = tuple(_update_metric(m, s, preds, labels, mb_mask)
+                       for m, s in zip(metrics, ms))
+            return (g_acc, l_acc, r_acc, new_bstats, ms), ()
+
+        xs = (micro,) if micro_mask is None else (micro, micro_mask)
+        carry0 = (g0, jnp.float32(0), jnp.float32(0), state.batch_stats, ms0)
+        (g_acc, l_acc, r_acc, new_bstats, new_mstats), _ = lax.scan(
+            body, carry0, xs)
+        denom = jnp.maximum(r_acc, 1.0)
+        grads = jax.tree.map(lambda a, p: (a / denom).astype(p.dtype),
+                             g_acc, state.params)
+        new_state = state.apply_gradients(grads=grads)
+        if new_bstats is not None:
+            new_state = new_state.replace(batch_stats=new_bstats)
+        return new_state, loss_sum + l_acc / denom, new_mstats
+
+    return train_step
+
+
 class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
     def __init__(
         self,
@@ -193,6 +337,9 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         steps_per_dispatch: int = 1,
         checkpoint_interval: int = 1,
         prefetch_to_device: Optional[int] = None,
+        accum_steps: Optional[int] = None,
+        remat: Optional[str] = None,
+        seq_sharded: Optional[bool] = None,
     ):
         if model is None and model_creator is None:
             raise ValueError("pass model or model_creator")
@@ -236,7 +383,56 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         #: synchronous placement (tests/test_feed_pipeline.py). The
         #: device-resident path ignores it (nothing streams).
         self.prefetch_to_device = prefetch_to_device
+        #: gradient-accumulation microbatches per optimizer step (None = the
+        #: RDT_TRAIN_ACCUM_STEPS knob, default 1). k splits every global
+        #: batch into k scanned microbatches whose row-weighted grad/loss/
+        #: metric accumulation reproduces the unaccumulated update while
+        #: only one microbatch's activations are live — peak activation
+        #: bytes drop ~k×. Must divide batch_size.
+        self.accum_steps = accum_steps
+        #: rematerialization policy for the train-step forward
+        #: ('none' | 'dots' | 'full'; None = the RDT_TRAIN_REMAT knob) —
+        #: jax.checkpoint placement per parallel/roles.py remat_policy
+        self.remat = remat
+        #: shard declared sequence dims (dim 1 of ndim >= 2 batch leaves)
+        #: over the mesh's ``seq`` axis (None = auto: on whenever the mesh
+        #: has a >1 seq extent). Layout-only — results stay row-identical.
+        self.seq_sharded = seq_sharded
         self._result: Optional[TrainingResult] = None
+
+    def _resolve_accum(self) -> int:
+        """The effective accumulation factor for THIS fit (the constructor
+        argument wins over the knob; knob read at call time — per-action
+        scope). Validated against batch_size: k must slice the global batch
+        into equal microbatches or the scanned program cannot reshape it."""
+        k = self.accum_steps if self.accum_steps is not None \
+            else int(knobs.get("RDT_TRAIN_ACCUM_STEPS"))
+        k = max(1, int(k))
+        if k > 1 and self.batch_size % k:
+            raise ValueError(
+                f"accum_steps={k} must divide batch_size={self.batch_size}")
+        return k
+
+    def _resolve_remat(self) -> str:
+        """The effective remat mode for THIS fit, validated against the
+        REMAT_MODES vocabulary (remat_policy raises on an unknown mode)."""
+        from raydp_tpu.parallel.roles import remat_policy
+
+        mode = (self.remat if self.remat is not None
+                else str(knobs.get("RDT_TRAIN_REMAT"))).lower()
+        remat_policy(mode)  # validate eagerly: fail before any compile
+        return mode
+
+    def _use_seq(self, mesh) -> bool:
+        """Does THIS fit extend batch shardings over the mesh's seq axis?
+        Auto-on when the mesh has a >1 seq extent; ``seq_sharded=False``
+        opts out (and True without a seq extent stays off — there is
+        nothing to shard over)."""
+        from raydp_tpu.parallel.mesh import seq_extent
+
+        if seq_extent(mesh) <= 1:
+            return False
+        return True if self.seq_sharded is None else bool(self.seq_sharded)
 
     # ------------------------------------------------------------------ build
     def _build_model(self):
@@ -289,6 +485,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         dp_total = int(np.prod([mesh.shape[a] for a in data_axes(mesh)]))
         pad_tail = (dp_total > 1 and bool(knobs.get("RDT_TRAIN_PAD_TAIL"))
                     and _loss_takes_mask(self._loss))
+        use_seq = self._use_seq(mesh)
 
         # device-resident fast path: dataset pinned in HBM, whole epoch in one
         # jitted dispatch with on-device shuffling (falls back to the
@@ -302,7 +499,8 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                               shuffle=self.shuffle, seed=self.seed,
                               drop_remainder=self.drop_last,
                               pad_remainder=pad_tail and not self.drop_last,
-                              prefetch_to_device=self.prefetch_to_device)
+                              prefetch_to_device=self.prefetch_to_device,
+                              seq=use_seq)
         eval_feed = eval_cache = None
         eval_tail_ok = False
         if evaluate_ds is not None:
@@ -325,7 +523,8 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                                        mesh=mesh, shuffle=False,
                                        drop_remainder=dp_total > 1,
                                        pad_remainder=pad_tail,
-                                       prefetch_to_device=self.prefetch_to_device)
+                                       prefetch_to_device=self.prefetch_to_device,
+                                       seq=use_seq)
 
         state, history = self._train_loop(
             mesh, feed, eval_feed, ckpt_dir, max_retries=max_retries,
@@ -393,30 +592,20 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         rdt_metrics.set_gauge("train_param_bytes_per_process",
                             addressable_nbytes(state))
         b_sharding = batch_sharding(mesh)
+        # seq-extended sharding for ndim >= 2 batch leaves on the resident
+        # path (the streaming DeviceFeed carries its own — decided in fit());
+        # None when the mesh has no >1 seq extent
+        seq_sharding = batch_sharding(mesh, seq=True) \
+            if self._use_seq(mesh) else None
 
-        compute_dtype = self.compute_dtype
-        split_batch = self._split_batch
+        # the activation-side plane: accumulation factor and remat policy,
+        # resolved per fit (constructor args win over the PER_ACTION knobs)
+        accum = self._resolve_accum()
+        remat_mode = self._resolve_remat()
+        rdt_metrics.set_gauge("train_accum_steps", accum)
 
-        def _apply(params, bstats, batch, train: bool):
-            inputs, labels = split_batch(batch)
-            inputs = _cast_floating(inputs, compute_dtype)
-            variables = {"params": params}
-            kwargs = {"train": train} if takes_train else {}
-            if bstats is not None:
-                variables["batch_stats"] = bstats
-                if train:
-                    preds, updates = model.apply(
-                        variables, inputs, mutable=["batch_stats"], **kwargs)
-                    new_bstats = updates["batch_stats"]
-                else:
-                    preds = model.apply(variables, inputs, **kwargs)
-                    new_bstats = bstats
-            else:
-                preds = model.apply(variables, inputs, **kwargs)
-                new_bstats = None
-            if preds.ndim == labels.ndim + 1 and preds.shape[-1] == 1:
-                preds = preds.squeeze(-1)
-            return preds.astype(jnp.float32), labels, new_bstats
+        _apply = _make_apply(model, takes_train, self._split_batch,
+                             self.compute_dtype)
 
         # Loss accumulators are threaded THROUGH the jitted steps rather than
         # collected as a host-side list: under a multi-process gang, an eager
@@ -425,26 +614,31 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         # same order — a rank that is one step behind deadlocks the gang. With
         # in-jit accumulation the only host reads are float() of replicated
         # scalars at epoch end (also one fewer host sync single-process).
-        def train_step(state, batch, mstats, loss_sum):
-            batch, mask = _strip_mask(batch)
+        train_step = _make_train_step(_apply, loss_fn, metrics, accum,
+                                      remat_mode,
+                                      mb_shardings=(b_sharding, seq_sharding))
 
-            def _loss(params):
-                preds, labels, new_bstats = _apply(
-                    params, state.batch_stats, batch, train=True)
-                lv = loss_fn(preds, labels, mask=mask) if mask is not None \
-                    else loss_fn(preds, labels)
-                return lv, (preds, new_bstats)
+        # publish the compiled step's peak temp (activation) bytes when the
+        # activation plane is engaged — the residency number accumulation/
+        # remat drive down, read off XLA's memory_analysis at first dispatch.
+        # Best-effort: some backends lack the analysis, and telemetry must
+        # never fail (or slow an un-engaged) fit.
+        measured = [accum <= 1 and remat_mode == "none"]
 
-            (loss_val, (preds, new_bstats)), grads = jax.value_and_grad(
-                _loss, has_aux=True)(state.params)
-            new_state = state.apply_gradients(grads=grads)
-            if new_bstats is not None:
-                new_state = new_state.replace(batch_stats=new_bstats)
-            _, labels = split_batch(batch)
-            new_mstats = tuple(
-                _update_metric(m, s, preds, labels, mask)
-                for m, s in zip(metrics, mstats))
-            return new_state, loss_sum + loss_val.astype(jnp.float32), new_mstats
+        def _note_activation(fn, *args):
+            measured[0] = True
+            try:
+                with profiler.trace("train:accum", "training"):
+                    mem = fn.lower(*args).compile().memory_analysis()
+                temp = getattr(mem, "temp_size_in_bytes", None)
+                if temp is not None:
+                    local = sum(1 for d in mesh.devices.flat
+                                if d.process_index == jax.process_index())
+                    rdt_metrics.set_gauge(
+                        "train_activation_bytes_per_process",
+                        int(temp) * max(1, local))
+            except Exception:  # noqa: BLE001 - telemetry only
+                pass
 
         # eval threads BOTH accumulators (row-weighted loss sum AND the row
         # count) through the jitted step: under pad-and-mask the real row
@@ -500,7 +694,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
             epoch_fn, cache_steps = cache.make_epoch_fn(
                 _step, self.batch_size, self.shuffle,
-                batch_sharding=b_sharding)
+                batch_sharding=b_sharding, seq_sharding=seq_sharding)
             jit_epoch = jax.jit(epoch_fn, donate_argnums=(0,))
 
         jit_eval_epoch = None
@@ -523,7 +717,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
             eval_epoch_fn, esteps = eval_cache.make_epoch_fn(
                 _eval_scan_step, self.batch_size, shuffle=False,
-                batch_sharding=b_sharding)
+                batch_sharding=b_sharding, seq_sharding=seq_sharding)
             jit_eval_epoch = jax.jit(eval_epoch_fn)
             tail_off = esteps * self.batch_size
             tail_rows = eval_cache.num_rows - tail_off
@@ -572,6 +766,9 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                     td = time.perf_counter()
                     ekey = jax.random.fold_in(
                         jax.random.PRNGKey(self.seed), epoch)
+                    if not measured[0]:
+                        _note_activation(jit_epoch, (state, loss_sum, mstats),
+                                         cache.arrays, ekey)
                     state, loss_sum, mstats = jit_epoch(
                         (state, loss_sum, mstats), cache.arrays, ekey)
                     # dispatch is async: fetch the loss scalar INSIDE this
@@ -594,10 +791,16 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                         td = time.perf_counter()
                         if chain > 1:
                             batches, k = item
+                            if not measured[0]:
+                                _note_activation(jit_chain, state, batches,
+                                                 mstats, loss_sum)
                             state, loss_sum, mstats = jit_chain(
                                 state, batches, mstats, loss_sum)
                         else:
                             k = 1
+                            if not measured[0]:
+                                _note_activation(jit_train, state, item,
+                                                 mstats, loss_sum)
                             state, loss_sum, mstats = jit_train(
                                 state, item, mstats, loss_sum)
                         t_disp += time.perf_counter() - td
@@ -744,7 +947,8 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         feed = DeviceFeed(ds, self.batch_size, o["columns"], mesh=o["mesh"],
                           shuffle=False, drop_remainder=o["drop_last"],
                           pad_remainder=o["pad_tail"],
-                          prefetch_to_device=self.prefetch_to_device)
+                          prefetch_to_device=self.prefetch_to_device,
+                          seq=o.get("seq", False))
         t0 = _time.perf_counter()
         mstats = tuple(m.init() for m in self._metrics)
         loss_sum = np.zeros((), np.float32)
@@ -786,7 +990,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
 
         from raydp_tpu.data.feed import HostBatchIterator
         from raydp_tpu.parallel import param_sharding_rules
-        from raydp_tpu.parallel.mesh import data_axes
+        from raydp_tpu.parallel.mesh import batch_sharding, data_axes
 
         mesh = self._build_mesh()
         columns = self._columns()
@@ -814,43 +1018,18 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         state = self._place_state(
             state, param_sharding_rules(mesh, self.param_rules)(state))
 
-        compute_dtype = self.compute_dtype
-        split_batch = self._split_batch
-
-        def train_step(state, batch, mstats, loss_sum):
-            batch, mask = _strip_mask(batch)
-
-            def _loss(params):
-                inputs, labels = split_batch(batch)
-                inputs = _cast_floating(inputs, compute_dtype)
-                vs = {"params": params}
-                kwargs = {"train": True} if takes_train else {}
-                new_bstats = None
-                if state.batch_stats is not None:
-                    vs["batch_stats"] = state.batch_stats
-                    preds, updates = model.apply(
-                        vs, inputs, mutable=["batch_stats"], **kwargs)
-                    new_bstats = updates["batch_stats"]
-                else:
-                    preds = model.apply(vs, inputs, **kwargs)
-                if preds.ndim == labels.ndim + 1 and preds.shape[-1] == 1:
-                    preds = preds.squeeze(-1)
-                preds = preds.astype(jnp.float32)
-                lv = loss_fn(preds, labels, mask=mask) if mask is not None \
-                    else loss_fn(preds, labels)
-                return lv, (preds, new_bstats)
-
-            (loss_val, (preds, new_bstats)), grads = jax.value_and_grad(
-                _loss, has_aux=True)(state.params)
-            new_state = state.apply_gradients(grads=grads)
-            if new_bstats is not None:
-                new_state = new_state.replace(batch_stats=new_bstats)
-            _, labels = split_batch(batch)
-            new_mstats = tuple(
-                _update_metric(m, s, preds, labels, mask)
-                for m, s in zip(metrics, mstats))
-            return (new_state, loss_sum + loss_val.astype(jnp.float32),
-                    new_mstats)
+        # the SAME step body as fit()'s (one source): the online path gets
+        # gradient accumulation and remat for free, and the two cannot drift
+        accum = self._resolve_accum()
+        from raydp_tpu import metrics as rdt_metrics
+        rdt_metrics.set_gauge("train_accum_steps", accum)
+        train_step = _make_train_step(
+            _make_apply(model, takes_train, self._split_batch,
+                        self.compute_dtype),
+            loss_fn, metrics, accum, self._resolve_remat(),
+            mb_shardings=(batch_sharding(mesh),
+                          batch_sharding(mesh, seq=True)
+                          if self._use_seq(mesh) else None))
 
         dp_total = int(np.prod([mesh.shape[a] for a in data_axes(mesh)]))
         # the ragged micro-batch tail under a >1 data extent: pad-and-mask
@@ -866,6 +1045,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
             "jit_train": jax.jit(train_step, donate_argnums=(0, 3)),
             "drop_last": dp_total > 1 and not pad_tail,
             "pad_tail": pad_tail,
+            "seq": self._use_seq(mesh),
             "history": [],
         }
 
@@ -993,6 +1173,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         feed = DeviceFeed(
             train_ds, self.batch_size, columns, mesh=mesh,
             prefetch_to_device=self.prefetch_to_device,
+            seq=self._use_seq(mesh),
             host_iter=GangShardIterator(
                 train_ds, self.batch_size, ctx.world_size, ctx.rank, columns,
                 shuffle=self.shuffle, seed=self.seed, row_range=row_range))
@@ -1002,6 +1183,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
             eval_feed = DeviceFeed(
                 eval_ds, self.batch_size, columns, mesh=mesh,
                 prefetch_to_device=self.prefetch_to_device,
+                seq=self._use_seq(mesh),
                 host_iter=GangShardIterator(
                     eval_ds, self.batch_size, ctx.world_size, ctx.rank,
                     columns, shuffle=False, seed=self.seed,

@@ -424,3 +424,160 @@ def test_sharded_export_serve_bitwise_matches_predict(session, tmp_path):
     got = sv.predict_table(pa.table({"x1": pdf["x1"].values,
                                      "x2": pdf["x2"].values}))
     assert np.array_equal(got, ref)
+
+
+# ---- activation-side parallelism (PR 17): accum × remat × seq ---------------
+# Gradient accumulation, role-driven rematerialization and seq-axis
+# activation sharding are residency/layout levers — every test here is a
+# parity contract against the unaccumulated / unsharded run.
+
+
+def test_accum_parity_across_meshes(session):
+    """accum=4 reproduces the accum=1 per-epoch loss trajectory on dp,
+    fsdp and fsdp×tp meshes: row-weighted microbatch accumulation is the
+    same math as the full-batch step, whatever the param layout."""
+    from raydp_tpu.data.dataset import from_frame
+
+    ds = from_frame(_linear_df(session))
+    losses0 = [h["train_loss"]
+               for h in _mlp_estimator(mesh_spec=MeshSpec()).fit(ds).history]
+
+    for spec in (MeshSpec(), MeshSpec(fsdp=8), dict(fsdp=4, tensor=2)):
+        r = _mlp_estimator(mesh_spec=spec, accum_steps=4).fit(ds)
+        np.testing.assert_allclose(
+            [h["train_loss"] for h in r.history], losses0, rtol=5e-4,
+            err_msg=f"accum=4 diverged on mesh_spec={spec}")
+
+    # the engaged plane publishes its telemetry: the accumulation factor
+    # and the compiled step's peak temp bytes (XLA memory_analysis)
+    from raydp_tpu import metrics
+
+    snap = metrics.snapshot()["gauges"]
+    assert snap["train_accum_steps"][""] == 4
+    assert snap["train_activation_bytes_per_process"][""] > 0
+
+
+def test_accum_knob_matches_constructor(session, monkeypatch):
+    """RDT_TRAIN_ACCUM_STEPS=4 builds the identical step program as
+    accum_steps=4 — same losses bitwise — and an accum that does not
+    divide the batch fails loudly, not by silently truncating rows."""
+    import pytest
+
+    from raydp_tpu.data.dataset import from_frame
+
+    ds = from_frame(_linear_df(session, n=1024))
+    r1 = _mlp_estimator(mesh_spec=MeshSpec(), accum_steps=4).fit(ds)
+    monkeypatch.setenv("RDT_TRAIN_ACCUM_STEPS", "4")
+    r2 = _mlp_estimator(mesh_spec=MeshSpec()).fit(ds)
+    monkeypatch.delenv("RDT_TRAIN_ACCUM_STEPS")
+    np.testing.assert_array_equal(
+        [h["train_loss"] for h in r2.history],
+        [h["train_loss"] for h in r1.history])
+
+    with pytest.raises(ValueError, match="divide"):
+        _mlp_estimator(mesh_spec=MeshSpec(), accum_steps=5).fit(ds)
+
+
+def test_remat_modes_identical_losses(session):
+    """jax.checkpoint placement (none/dots/full) recomputes, never
+    approximates: loss trajectories agree to float-summation noise (the
+    recompute can re-associate reductions, nothing more) across remat
+    modes, with accumulation and an fsdp mesh engaged."""
+    from raydp_tpu.data.dataset import from_frame
+
+    ds = from_frame(_linear_df(session, n=1024))
+    ref = _mlp_estimator(mesh_spec=MeshSpec(fsdp=8), accum_steps=4,
+                         remat="none").fit(ds)
+    for mode in ("dots", "full"):
+        r = _mlp_estimator(mesh_spec=MeshSpec(fsdp=8), accum_steps=4,
+                           remat=mode).fit(ds)
+        np.testing.assert_allclose(
+            [h["train_loss"] for h in r.history],
+            [h["train_loss"] for h in ref.history], rtol=1e-6,
+            err_msg=f"remat={mode} changed the math")
+
+
+def test_seq_sharded_parity(session):
+    """data=4 × seq=2: feature dims shard over the seq axis on top of the
+    batch dim — a pure layout change, so per-epoch losses match the
+    seq-less dp run and per-row predictions agree tightly."""
+    from raydp_tpu.data.dataset import from_frame
+
+    df = _linear_df(session)
+    ds = from_frame(df)
+    base = _mlp_estimator(mesh_spec=MeshSpec())
+    r0 = base.fit(ds)
+
+    seq = _mlp_estimator(mesh_spec=dict(data=4, seq=2))
+    r1 = seq.fit(ds)
+    np.testing.assert_allclose(
+        [h["train_loss"] for h in r1.history],
+        [h["train_loss"] for h in r0.history], rtol=5e-4)
+
+    feats = from_frame(df.select("x1", "x2"))
+    np.testing.assert_allclose(seq.predict(feats), base.predict(feats),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_seq_sharded_with_accum_and_remat(session):
+    """The full activation plane at once — accum=4 × remat=full ×
+    data=4/seq=2 — still lands the plain single-mesh trajectory."""
+    from raydp_tpu.data.dataset import from_frame
+
+    ds = from_frame(_linear_df(session))
+    losses0 = [h["train_loss"]
+               for h in _mlp_estimator(mesh_spec=MeshSpec()).fit(ds).history]
+    r = _mlp_estimator(mesh_spec=dict(data=4, seq=2), accum_steps=4,
+                       remat="full").fit(ds)
+    np.testing.assert_allclose(
+        [h["train_loss"] for h in r.history], losses0, rtol=5e-4)
+
+
+def test_accum_ragged_tail_partial_fit(session):
+    """40 rows, batch 64, accum=4 under fsdp=8: the padded tail splits
+    into microbatches where the LAST is all padding — its rows-weight is
+    zero, so the masked online step still matches the unaccumulated one."""
+    from raydp_tpu.data.dataset import from_frame
+
+    ds = from_frame(_linear_df(session, n=40, parts=2))
+
+    plain = _mlp_estimator(mesh_spec=MeshSpec(fsdp=8))._partial_fit_epoch(
+        ds, 0)
+    accum = _mlp_estimator(
+        mesh_spec=MeshSpec(fsdp=8), accum_steps=4)._partial_fit_epoch(ds, 0)
+    assert accum["steps"] == plain["steps"] == 1
+    np.testing.assert_allclose(accum["train_loss"], plain["train_loss"],
+                               rtol=5e-4)
+
+
+def test_accum_checkpoint_roundtrip(session, tmp_path):
+    """Accumulation holds no state across optimizer steps: a checkpoint
+    written by an accum=4 fit restores bit-identically to the live state,
+    and a longer accum=4 run resumes from it epoch-for-epoch."""
+    import jax
+
+    from raydp_tpu.data.dataset import from_frame
+    from raydp_tpu.parallel import param_sharding_rules
+    from raydp_tpu.train import checkpoint as ckpt
+
+    ds = from_frame(_linear_df(session, n=1024))
+    ckpt_dir = str(tmp_path / "ck")
+    est = _mlp_estimator(mesh_spec=MeshSpec(fsdp=8), num_epochs=2,
+                         ckpt_dir=ckpt_dir, accum_steps=4)
+    r1 = est.fit(ds)
+    trained = est.get_state()
+    shardings = param_sharding_rules(trained.params["Dense_0"]["kernel"]
+                                     .sharding.mesh, None)(trained)
+    restored, step = ckpt.restore_placed(ckpt_dir, trained, shardings)
+    assert step == 1
+    for a, b in zip(jax.tree.leaves(trained), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    resumed = _mlp_estimator(mesh_spec=MeshSpec(fsdp=8), num_epochs=4,
+                             ckpt_dir=ckpt_dir, accum_steps=4)
+    r2 = resumed.fit(ds)
+    assert [h["epoch"] for h in r2.history] == [0, 1, 2, 3]
+    np.testing.assert_allclose(
+        [h["train_loss"] for h in r2.history[:2]],
+        [h["train_loss"] for h in r1.history], rtol=1e-6)
+    assert r2.history[-1]["train_loss"] < r1.history[-1]["train_loss"]
