@@ -256,6 +256,50 @@ _ALL = [
        "servable's in-flight dispatches to drain before unloading it "
        "anyway (in-flight requests on it still complete; the registry "
        "entry just goes away)."),
+    _k("RDT_SERVE_CANARY_WEIGHT", "float", 0.1, PER_ACTION, "serving",
+       "Traffic share a guarded rollout gives the canary version the "
+       "moment it loads (the first ramp step). Read per rollout."),
+    _k("RDT_SERVE_ROLLOUT_RAMP", "str", "0.25,0.5,1.0", PER_ACTION,
+       "serving",
+       "Comma-separated non-decreasing weight schedule a rollout ramps "
+       "the canary through after the initial canary weight, each step "
+       "judged healthy before the next."),
+    _k("RDT_SERVE_ROLLOUT_STEP_S", "float", 30.0, PER_ACTION, "serving",
+       "Longest a rollout holds one ramp step waiting for the judgment "
+       "window to fill; a step that times out without evidence either "
+       "way advances (insufficient traffic is not a regression)."),
+    _k("RDT_SERVE_ROLLOUT_MIN_SAMPLES", "int", 32, PER_ACTION, "serving",
+       "Step-local requests BOTH the canary and the baseline must have "
+       "answered before a health verdict is allowed — a one-request "
+       "blip must not kill a deploy."),
+    _k("RDT_SERVE_ROLLOUT_ERR_TOL", "float", 0.02, PER_ACTION, "serving",
+       "Absolute error-rate margin the canary may exceed the baseline "
+       "by within a ramp step before the rollout rolls back."),
+    _k("RDT_SERVE_ROLLOUT_P99_FACTOR", "float", 2.0, PER_ACTION,
+       "serving",
+       "Multiple of the baseline's per-version p99 the canary's p99 "
+       "must exceed (with full windows on both sides) before the "
+       "rollout rolls back on latency."),
+    _k("RDT_SERVE_MIN_REPLICAS", "int", 1, PER_ACTION, "serving",
+       "Serving-autoscaler floor on per-version replica count."),
+    _k("RDT_SERVE_MAX_REPLICAS", "int", 4, PER_ACTION, "serving",
+       "Serving-autoscaler ceiling on per-version replica count."),
+    _k("RDT_SERVE_SCALE_INTERVAL_S", "float", 1.0, PER_ACTION, "serving",
+       "Seconds between serving-autoscaler ticks (each tick reads one "
+       "serving_report and decides at most one scale event)."),
+    _k("RDT_SERVE_SCALE_UP_S", "float", 3.0, PER_ACTION, "serving",
+       "Sustained dispatch pressure (queue depth beyond replica "
+       "capacity, or the admission queue half full) required before the "
+       "serving autoscaler adds a replica — a momentary spike never "
+       "scales by itself."),
+    _k("RDT_SERVE_SCALE_IDLE_S", "float", 30.0, PER_ACTION, "serving",
+       "Sustained full idleness (zero queued, zero outstanding) before "
+       "the serving autoscaler drains a replica back."),
+    _k("RDT_SERVE_SCALE_COOLDOWN_S", "float", 10.0, PER_ACTION,
+       "serving",
+       "Hysteresis after any serving scale event: no further scale "
+       "decisions until it passes (sustained windows keep accumulating "
+       "through it)."),
     # ---- continuous pipelines -----------------------------------------------
     _k("RDT_STREAM_RETAIN", "int", 64, PER_ACTION, "stream",
        "Epochs of replay state a continuous pipeline keeps: the source "
@@ -276,6 +320,12 @@ _ALL = [
     _k("RDT_STREAM_MAX_PARTITIONS", "int", 0, PER_ACTION, "stream",
        "Partitions each micro-batch epoch is split into before its engine "
        "action (0 = auto: min(executors, rows))."),
+    _k("RDT_STREAM_ROLLOUT", "bool", False, PER_ACTION, "stream",
+       "Ship partial_fit exports through a guarded rollout (canary ramp "
+       "+ auto-rollback, doc/serving.md) instead of an immediate "
+       "hot_swap. The partial_fit rollout= argument overrides; rollouts "
+       "block on serving traffic, so the default stays the atomic "
+       "swap."),
     # ---- runtime ------------------------------------------------------------
     _k("RDT_LOG_LEVEL", "str", "INFO", PROCESS_START, "runtime",
        "Log level of spawned processes (node agents, SPMD rank workers)."),

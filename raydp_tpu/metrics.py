@@ -194,7 +194,39 @@ _ALL_METRICS = [
        "Per-request latency from enqueue to demuxed completion."),
     _m("serve_hot_swaps_total", COUNTER, "1", "serving",
        "Servable hot-swaps completed by a serving session (new version "
-       "loaded beside the old, traffic shifted, old retired)."),
+       "loaded beside the old, traffic shifted, old retired; guarded-"
+       "rollout promotions count here too)."),
+    _m("serve_version_requests_total", COUNTER, "1", "serving",
+       "Requests answered per live servable version (label "
+       "'<session>:v<N>') — the rollout judgment's traffic counter.",
+       label="version"),
+    _m("serve_version_failed_total", COUNTER, "1", "serving",
+       "Requests failed per servable version (the rollout judgment's "
+       "error-rate numerator).", label="version"),
+    _m("serve_version_request_seconds", HISTOGRAM, "s", "serving",
+       "Per-request latency per servable version — the per-version p99 "
+       "window a guarded rollout judges the canary on.", label="version"),
+    _m("serve_version_weight", GAUGE, "1", "serving",
+       "Current dispatch-traffic weight of each live servable version "
+       "(0 after a drop/rollback).", label="version"),
+    _m("serve_version_replicas", GAUGE, "1", "serving",
+       "Replica count of each live servable version (the serving "
+       "autoscaler's actuator target).", label="version"),
+    _m("serve_unload_failed_total", COUNTER, "1", "serving",
+       "Retired replicas that still refused serve_unload at the retry "
+       "deadline — their servable's weights stay pinned in that "
+       "executor's RAM (loud leak counter; see the unload_failed "
+       "event)."),
+    _m("serve_rollouts_total", COUNTER, "1", "serving",
+       "Guarded rollouts started (RolloutController.run)."),
+    _m("serve_rollouts_rolled_back_total", COUNTER, "1", "serving",
+       "Guarded rollouts auto-rolled-back on an unhealthy verdict (or "
+       "timeout); the complement promoted."),
+    _m("serve_scaled_up_total", COUNTER, "1", "serving",
+       "Serving-autoscaler replica additions (every live version grows "
+       "together)."),
+    _m("serve_scaled_down_total", COUNTER, "1", "serving",
+       "Serving-autoscaler replica drains after sustained idleness."),
     # ---- continuous pipelines -----------------------------------------------
     _m("stream_epochs_total", COUNTER, "1", "stream",
        "Micro-batch epochs a continuous pipeline completed (transform ran, "
@@ -368,6 +400,21 @@ _ALL_EVENTS = [
     _e("hot_swap", "serving",
        "A serving session atomically shifted traffic to a freshly loaded "
        "servable version (the old one retires in the background)."),
+    _e("unload_failed", "serving",
+       "A retired replica refused serve_unload through the whole retry "
+       "window — its servable's weights stay pinned in that executor "
+       "process (loud leak record: replica, executor, version, error)."),
+    _e("rollout_promote", "serving",
+       "A guarded rollout ramped its canary to full weight healthy and "
+       "promoted it to primary through the swap/retire machinery."),
+    _e("rollout_rollback", "serving",
+       "A guarded rollout auto-rolled-back: the canary judged unhealthy "
+       "(error-rate or p99 vs baseline) or the rollout timed out — "
+       "weight to 0, canary unloaded, blackbox bundle written with the "
+       "failing step's numbers."),
+    _e("serve_scale", "serving",
+       "The serving autoscaler changed (or failed to change) the "
+       "per-version replica count (direction, replicas, reason)."),
     _e("stream_replay", "stream",
        "A continuous pipeline re-derived a lost epoch blob from its "
        "source journal (exactly-once replay; epoch + reason recorded)."),

@@ -5,8 +5,12 @@ inference service over the executor pool (doc/serving.md).
     est.export_serving("/shared/model-v1")
     with ServingSession("/shared/model-v1", session=session) as srv:
         preds = srv.predict(rows)
+        srv.autoscale()                    # replicas follow queue depth
+        srv.rollout("/shared/model-v2")    # guarded canary deploy
 """
 
+from raydp_tpu.serve.autoscale import ServingAutoscaler  # noqa: F401
+from raydp_tpu.serve.rollout import RolloutController  # noqa: F401
 from raydp_tpu.serve.servable import (  # noqa: F401
     Servable, export_bundle, load_servable,
 )
@@ -14,5 +18,6 @@ from raydp_tpu.serve.session import (  # noqa: F401
     ServingError, ServingOverloaded, ServingSession,
 )
 
-__all__ = ["Servable", "ServingError", "ServingOverloaded",
-           "ServingSession", "export_bundle", "load_servable"]
+__all__ = ["RolloutController", "Servable", "ServingAutoscaler",
+           "ServingError", "ServingOverloaded", "ServingSession",
+           "export_bundle", "load_servable"]

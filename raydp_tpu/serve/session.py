@@ -11,20 +11,29 @@ the existing actor RPC plane. Mechanisms, each reusing an ETL-plane design:
   ``RDT_SERVE_BATCH_TIMEOUT_MS`` latency budget; the batched output demuxes
   back per request. The replica side stages decode/H2D for the next batch
   on a ``DevicePrefetcher`` thread while the jitted apply runs (PR 1).
+- **multi-version weighted routing** — the session keeps N live *version
+  groups* (servable version, its replicas, a routing weight) and assigns
+  each dispatch a version by smooth weighted round-robin BEFORE choosing a
+  replica; a request is answered by exactly one version, re-routes and
+  hedges stay inside that version's replica set, and a canary at weight
+  0.1 therefore answers ~10% of dispatches and 0% of the baseline's
+  (doc/serving.md "Guarded rollouts").
 - **replica routing + hedged requests** — dispatches land on the
-  least-busy replica (per-replica in-flight counters, ties rotating — the
-  PR 5 scheduler's shape); a dispatch older than
+  least-busy replica of their version (per-replica in-flight counters,
+  ties rotating — the PR 5 scheduler's shape); a dispatch older than
   ``max(RDT_SERVE_HEDGE_MULTIPLIER × latency-quantile,
-  RDT_SERVE_HEDGE_MIN_MS)`` is hedged onto a second replica, first
-  responder wins, the loser's result is discarded and counted (PR 5's
-  speculation, re-aimed at tail latency).
+  RDT_SERVE_HEDGE_MIN_MS)`` is hedged onto a second replica of the SAME
+  version, first responder wins, the loser's result is discarded and
+  counted (PR 5's speculation, re-aimed at tail latency).
 - **fault path** — a replica that dies mid-request (connection lost, or a
   restarted executor answering ``ReplicaNotLoaded``) re-routes the dispatch
   through the same hedge machinery instead of surfacing an error; the
-  replica reloads in the background and rejoins the rotation. Requests fail
-  only when every replica has refused within the re-route grace.
-- **observability** — per-replica request/batch/row counters, batch
-  occupancy and queue-depth gauges, and request p50/p99 in
+  replica reloads in the background (its OWN version's bundle) and rejoins
+  the rotation. Requests fail only when every replica of their version has
+  refused within the re-route grace.
+- **observability** — per-replica request/batch/row counters, per-VERSION
+  request/error counters and latency windows (the rollout judgment base),
+  batch occupancy and queue-depth gauges, and request p50/p99 in
   :meth:`serving_report` (the ``shuffle_stage_report`` twin), plus
   ``serve:batch`` / ``serve:hedge`` trace spans.
 
@@ -55,7 +64,8 @@ logger = get_logger("serve.session")
 #: completed-batch latencies required before the hedge deadline is trusted
 #: (below this the quantile is noise and hedging would fire on warmup jitter)
 _HEDGE_MIN_SAMPLES = 8
-#: bounded latency reservoirs (batch + request) for the quantile/report
+#: bounded latency reservoirs (batch + request + per-version) for the
+#: quantile/report
 _LAT_WINDOW = 2048
 
 
@@ -149,12 +159,16 @@ class _Attempt:
 
 
 class _Dispatch:
-    """One coalesced batch in flight (possibly on two replicas at once)."""
+    """One coalesced batch in flight (possibly on two replicas at once).
+    ``version`` pins it to ONE version group: every attempt — first route,
+    re-route, hedge — draws from that group's replicas, so a response is
+    always the output of exactly one servable version."""
 
     __slots__ = ("id", "payload", "rows", "parts", "attempts", "tried",
-                 "hedged", "done", "t_first", "last_error")
+                 "hedged", "done", "t_first", "last_error", "version")
 
-    def __init__(self, did: int, payload: bytes, rows: int, parts):
+    def __init__(self, did: int, payload: bytes, rows: int, parts,
+                 version: int):
         self.id = did
         self.payload = payload
         self.rows = rows
@@ -165,19 +179,25 @@ class _Dispatch:
         self.done = False
         self.t_first = time.monotonic()
         self.last_error: Optional[BaseException] = None
+        self.version = version
 
 
 class _ReplicaState:
     """Driver-side view of one replica: its actor handle, its in-flight
-    count, and its readiness (False while the executor restarts/reloads)."""
+    count, and its readiness (False while the executor restarts/reloads).
+    ``export_dir`` is the bundle THIS replica serves — the background
+    reload must restore a canary replica's canary bundle, not whatever the
+    session's primary happens to be."""
 
-    def __init__(self, rid: str, replica, executor_name: str):
+    def __init__(self, rid: str, replica, executor_name: str,
+                 export_dir: str):
         self.rid = rid
         #: the ActorHandle — named `replica` so rdtlint's rpc-surface rule
         #: resolves `replica.submit("serve_predict", ...)` call sites against
         #: the actor surface (tools/rdtlint/config.py RPC_RECEIVER_SURFACES)
         self.replica = replica
         self.executor = executor_name
+        self.export_dir = export_dir
         self.inflight = 0
         self.inflight_peak = 0
         self.ready = True
@@ -190,6 +210,32 @@ class _ReplicaState:
         self.reloads = 0
 
 
+class _VersionGroup:
+    """One live servable version: its replicas, its routing weight, and the
+    per-version health windows the rollout judgment reads. All fields are
+    dispatcher-owned after registration."""
+
+    def __init__(self, version: int, export_dir: str, tag: Optional[str],
+                 replicas: List[_ReplicaState], weight: float = 1.0):
+        self.version = version
+        self.export_dir = export_dir
+        self.tag = tag
+        self.weight = float(weight)
+        self.replicas = replicas
+        #: smooth-WRR credit: deterministic proportional interleave, so a
+        #: weight-0.25 canary answers exactly one dispatch in four (no RNG
+        #: — tests and the judgment windows see the configured split)
+        self.wrr = 0.0
+        #: next scale-up replica index (initial replicas claimed 0..n-1)
+        self.rid_seq = len(replicas)
+        # per-version health counters/windows (the judgment base: a global
+        # latency window would let a healthy baseline mask a regressing
+        # canary)
+        self.requests = 0
+        self.failed = 0
+        self.req_lat: List[float] = []
+
+
 class ServingSession:
     """See module docstring. Construct with a live ETL session (or an
     explicit executor-handle list) and a servable ``export_dir``:
@@ -198,6 +244,7 @@ class ServingSession:
         est.export_serving("/shared/model-v1")
         srv = ServingSession("/shared/model-v1", session=session)
         preds = srv.predict(rows)          # or predict_async(rows) -> Future
+        srv.rollout("/shared/model-v2")    # guarded canary → promote/rollback
         srv.serving_report(); srv.close()
 
     Knobs (all re-read at construction; doc/serving.md): batching
@@ -206,7 +253,9 @@ class ServingSession:
     ``RDT_SERVE_HEDGE_QUANTILE`` / ``RDT_SERVE_HEDGE_MULTIPLIER`` /
     ``RDT_SERVE_HEDGE_MIN_MS``, fault path ``RDT_SERVE_REROUTE_GRACE_S``,
     overload shedding ``RDT_SERVE_MAX_QUEUE``, replica staging
-    ``RDT_SERVE_PREFETCH``."""
+    ``RDT_SERVE_PREFETCH``; the rollout/autoscale knobs are read by
+    :class:`~raydp_tpu.serve.rollout.RolloutController` /
+    :class:`~raydp_tpu.serve.autoscale.ServingAutoscaler`."""
 
     def __init__(self, export_dir: str, session=None,
                  executors: Optional[List] = None,
@@ -254,22 +303,25 @@ class ServingSession:
         self._adm_lock = threading.Lock()
         self._outstanding = 0  # guarded-by: _adm_lock
         self._shed_count = 0   # guarded-by: _adm_lock
-        #: serializes hot_swap() callers (the swap itself applies on the
-        #: dispatcher thread; this only orders concurrent swap requests)
+        #: serializes hot_swap()/load_version()/scale_replicas() callers —
+        #: the structural changes themselves apply on the dispatcher
+        #: thread; this only orders concurrent load/version allocations
         self._swap_lock = threading.Lock()
+        self._next_version = 2  # guarded-by: _swap_lock
         self._swap_drain_s = max(
             0.0, float(knobs.get("RDT_SERVE_SWAP_DRAIN_S")))
 
-        self._replicas: List[_ReplicaState] = []
+        reps: List[_ReplicaState] = []
         loads = []
         for i, h in enumerate(executors):
             rid = f"{name}-r{i}"
-            rep = _ReplicaState(rid, h, getattr(h, "name", None) or f"ex{i}")
+            rep = _ReplicaState(rid, h, getattr(h, "name", None) or f"ex{i}",
+                                export_dir)
             # parallel load: each replica pays its jax import + jit once,
             # concurrently, instead of serializing session bring-up
             replica = rep.replica
             loads.append(replica.submit("serve_load", rid, export_dir))
-            self._replicas.append(rep)
+            reps.append(rep)
         for f in loads:
             f.result(timeout=180.0)
 
@@ -281,11 +333,11 @@ class ServingSession:
         self._parked: List[_Dispatch] = []     # waiting for a replica
         self._rr = itertools.count()
         self._did = itertools.count()
-        # servable-version state (dispatcher-owned after construction; the
-        # active version answers every new dispatch, retiring versions only
-        # finish what they already hold)
-        self._version = 1
-        self._active_tag: Optional[str] = None
+        # version-group state (dispatcher-owned after construction): the
+        # PRIMARY group is the baseline every new session starts with;
+        # canaries register beside it via load_version()
+        self._primary = _VersionGroup(1, export_dir, None, reps, weight=1.0)
+        self._groups: List[_VersionGroup] = [self._primary]
         self._swaps = 0
         #: (drain deadline, replicas, version) of swapped-out servables
         self._retiring: List = []
@@ -365,7 +417,10 @@ class ServingSession:
         """Saturated right now? While True the dispatcher suppresses
         hedging — a hedge is a duplicate dispatch, and duplicating work
         while shedding new requests amplifies exactly the overload the
-        shed exists to absorb."""
+        shed exists to absorb. The rollout judgment reads the same gate
+        (via ``serving_report``): saturation inflates BOTH versions'
+        windows, so a health verdict taken now would roll back a healthy
+        canary for the pool's overload."""
         with self._adm_lock:
             return self._max_queue > 0 \
                 and self._outstanding >= self._max_queue
@@ -374,56 +429,235 @@ class ServingSession:
                  timeout: float = 180.0) -> Dict[str, Any]:
         """Atomically roll the session onto a new servable under live
         traffic: load the bundle at ``export_dir`` BESIDE the active one on
-        every replica's executor (distinct replica ids — the registry holds
-        both), shift all new dispatches to it in one dispatcher step, and
-        retire the old version in the background once its in-flight work
-        drains (bounded by ``RDT_SERVE_SWAP_DRAIN_S``; stragglers still
-        complete, the registry entry just goes away). No request is dropped:
-        every response comes from exactly one version — the one its
-        dispatch was routed to. ``tag`` annotates the version in
-        :meth:`serving_report` (``partial_fit`` passes the source epoch).
-        Thread-safe; concurrent swaps serialize in call order."""
+        every primary replica's executor (distinct replica ids — the
+        registry holds both), shift all new primary dispatches to it in one
+        dispatcher step, and retire the old version in the background once
+        its in-flight work drains (bounded by ``RDT_SERVE_SWAP_DRAIN_S``;
+        stragglers still complete, the registry entry just goes away). No
+        request is dropped: every response comes from exactly one version —
+        the one its dispatch was routed to. ``tag`` annotates the version
+        in :meth:`serving_report` (``partial_fit`` passes the source
+        epoch). Thread-safe; concurrent swaps serialize in call order.
+
+        This is the UNGUARDED cut-over (100% of primary traffic the moment
+        the load lands); :meth:`rollout` is the guarded ramp on top."""
         if self._closed:
             raise ServingError("serving session is closed")
         with self._swap_lock:
-            # replica handles/executors are dispatcher-owned state (reloads
-            # re-bind them): snapshot them ON the dispatcher thread instead
-            # of racing _maybe_rebind from here
-            snap: Future = Future()
-            self._events.put(("swap_prep", snap))
-            members = snap.result(timeout=30.0)
-            v = self._version + 1
-            new_reps: List[_ReplicaState] = []
-            loads = []
-            for i, (handle, executor) in enumerate(members):
-                rid = f"{self.name}-v{v}-r{i}"
-                rep = _ReplicaState(rid, handle, executor)
-                # parallel load beside the active servable — the old rid
-                # keeps serving while the new one pays its jit
-                replica = rep.replica
-                loads.append(replica.submit("serve_load", rid, export_dir))
-                new_reps.append(rep)
-            errors = []
-            for f in loads:
-                try:
-                    f.result(timeout=timeout)
-                except Exception as e:  # noqa: BLE001 - collected below
-                    errors.append(e)
-            if errors:
-                # never leave a half-loaded version pinning executor RAM:
-                # unload whatever DID land, then surface the failure
-                self._unload_replicas(new_reps, v)
-                raise ServingError(
-                    f"hot swap to {export_dir!r} failed loading "
-                    f"{len(errors)}/{len(loads)} replica(s); the partial "
-                    f"load was rolled back") from errors[0]
+            v = self._next_version
+            self._next_version += 1
+            new_reps = self._load_beside_primary(export_dir, timeout, v)
             done: Future = Future()
             self._events.put(("swap", new_reps, export_dir, v, tag, done))
             return done.result(timeout=30.0)
 
+    def _load_beside_primary(self, export_dir: str, timeout: float,
+                             v: int) -> List["_ReplicaState"]:
+        """Load one replica of ``export_dir`` beside each primary replica
+        (caller thread — these are blocking RPCs) under the
+        caller-allocated version number ``v``. Returns the loaded
+        ``_ReplicaState`` list; a partial load is rolled back before the
+        error surfaces. Callers hold ``_swap_lock`` (the version
+        allocation and replica-id namespace)."""
+        # replica handles/executors are dispatcher-owned state (reloads
+        # re-bind them): snapshot them ON the dispatcher thread instead
+        # of racing _maybe_rebind from here
+        snap: Future = Future()
+        self._events.put(("swap_prep", snap))
+        members = snap.result(timeout=30.0)
+        new_reps: List[_ReplicaState] = []
+        loads = []
+        for i, (handle, executor) in enumerate(members):
+            rid = f"{self.name}-v{v}-r{i}"
+            rep = _ReplicaState(rid, handle, executor, export_dir)
+            # parallel load beside the active servable — the old rid
+            # keeps serving while the new one pays its jit
+            replica = rep.replica
+            loads.append(replica.submit("serve_load", rid, export_dir))
+            new_reps.append(rep)
+        errors = []
+        for f in loads:
+            try:
+                f.result(timeout=timeout)
+            except Exception as e:  # noqa: BLE001 - collected below
+                errors.append(e)
+        if errors:
+            # never leave a half-loaded version pinning executor RAM:
+            # unload whatever DID land, then surface the failure
+            threading.Thread(
+                target=self._unload_replicas, args=(new_reps, v),
+                daemon=True,
+                name=f"rdt-serve-loadfail-{self.name}-v{v}").start()
+            raise ServingError(
+                f"loading {export_dir!r} failed on "
+                f"{len(errors)}/{len(loads)} replica(s); the partial "
+                f"load was rolled back") from errors[0]
+        return new_reps
+
+    # ---- guarded rollout / weighted versions (doc/serving.md) ---------------
+    def load_version(self, export_dir: str, weight: float,
+                     tag: Optional[str] = None,
+                     timeout: float = 180.0) -> Dict[str, Any]:
+        """Load ``export_dir`` as a NEW live version group beside the
+        primary (one replica per primary replica, same executors) and start
+        routing ``weight`` of dispatch traffic to it. The building block
+        under :meth:`rollout`; pair with :meth:`set_weight` /
+        :meth:`promote_version` / :meth:`drop_version`."""
+        if self._closed:
+            raise ServingError("serving session is closed")
+        if weight < 0:
+            raise ValueError("weight must be >= 0")
+        with self._swap_lock:
+            v = self._next_version
+            self._next_version += 1
+            new_reps = self._load_beside_primary(export_dir, timeout, v)
+            group = _VersionGroup(v, export_dir, tag, new_reps,
+                                  weight=weight)
+            done: Future = Future()
+            self._events.put(("add_group", group, done))
+            return done.result(timeout=30.0)
+
+    def set_weight(self, version: int, weight: float) -> Dict[str, Any]:
+        """Re-weight a live version group (effective on the next dispatch,
+        in one dispatcher step). Weight 0 parks a version out of NEW
+        traffic without unloading it — its in-flight work still completes."""
+        if self._closed:
+            raise ServingError("serving session is closed")
+        if weight < 0:
+            raise ValueError("weight must be >= 0")
+        done: Future = Future()
+        self._events.put(("set_weight", int(version), float(weight), done))
+        return done.result(timeout=30.0)
+
+    def promote_version(self, version: int) -> Dict[str, Any]:
+        """Make a live canary group THE primary (weight 1.0) and retire the
+        old primary through the ordinary swap/retire machinery (drain, then
+        unload, bounded by ``RDT_SERVE_SWAP_DRAIN_S``). One dispatcher
+        step: a dispatch routed before it answers from the version it
+        chose; after it the canary is the baseline."""
+        if self._closed:
+            raise ServingError("serving session is closed")
+        done: Future = Future()
+        self._events.put(("promote", int(version), done))
+        return done.result(timeout=30.0)
+
+    def drop_version(self, version: int) -> Dict[str, Any]:
+        """Take a canary group OUT: weight to 0, replicas retired (in-flight
+        dispatches complete, then unload — the rollback half of a guarded
+        rollout). Parked dispatches that chose this version re-home to the
+        primary (they were never answered, so no response mixes versions).
+        The primary cannot be dropped."""
+        if self._closed:
+            raise ServingError("serving session is closed")
+        done: Future = Future()
+        self._events.put(("drop_group", int(version), done))
+        return done.result(timeout=30.0)
+
+    def rollout(self, export_dir: str, tag: Optional[str] = None,
+                timeout: Optional[float] = None,
+                **opts) -> Dict[str, Any]:
+        """Guarded deployment of ``export_dir``: load it as a canary at
+        ``RDT_SERVE_CANARY_WEIGHT``, ramp its traffic share on the
+        ``RDT_SERVE_ROLLOUT_RAMP`` schedule judging per-version error-rate
+        and p99 at every step, then auto-promote — or auto-roll-back on the
+        first unhealthy verdict (weight→0, unload, ``rollout_rollback``
+        event + blackbox bundle). Blocking; returns the outcome record.
+        See :class:`~raydp_tpu.serve.rollout.RolloutController`."""
+        from raydp_tpu.serve.rollout import RolloutController
+
+        return RolloutController(self, export_dir, tag=tag,
+                                 timeout=timeout, **opts).run()
+
+    def autoscale(self, min_replicas: Optional[int] = None,
+                  max_replicas: Optional[int] = None):
+        """Start a :class:`~raydp_tpu.serve.autoscale.ServingAutoscaler`
+        driving this session's per-version replica counts from queue
+        depth. Returns the started controller (caller stops it)."""
+        from raydp_tpu.serve.autoscale import ServingAutoscaler
+
+        return ServingAutoscaler(self, min_replicas=min_replicas,
+                                 max_replicas=max_replicas).start()
+
+    def scale_replicas(self, count: int,
+                       timeout: float = 180.0) -> Dict[str, Any]:
+        """Set EVERY live version group to ``count`` replicas (the
+        autoscaler's actuator). Growth loads new replicas onto the
+        least-loaded live executors (blocking RPCs on the caller thread);
+        shrink drains the least-busy replicas through the retire path —
+        their in-flight dispatches complete before the unload. Every group
+        gets the same count so a low-weight canary is never capacity-bound:
+        queueing inside the canary would inflate exactly the p99 window
+        the rollout judgment reads."""
+        if count < 1:
+            raise ValueError("count must be >= 1")
+        if self._closed:
+            raise ServingError("serving session is closed")
+        with self._swap_lock:
+            snap: Future = Future()
+            self._events.put(("scale_prep", snap))
+            groups = snap.result(timeout=30.0)
+            live = self._live_executors()
+            # replica count per executor name, across every group — growth
+            # packs the least-loaded member first
+            counts: Dict[str, int] = {}
+            handles: Dict[str, Any] = {}
+            for _v, _dir, _seq, members in groups:
+                for handle, executor in members:
+                    counts[executor] = counts.get(executor, 0) + 1
+                    handles.setdefault(executor, handle)
+            for h in live:
+                counts.setdefault(h.name, 0)
+                handles[h.name] = h
+            per_version: Dict[int, Any] = {}
+            for v, export_dir, rid_seq, members in groups:
+                have = len(members)
+                if count > have:
+                    new_reps: List[_ReplicaState] = []
+                    loads = []
+                    for k in range(count - have):
+                        executor = min(counts, key=counts.get)
+                        counts[executor] += 1
+                        rid = f"{self.name}-v{v}-r{rid_seq + k}"
+                        rep = _ReplicaState(rid, handles[executor],
+                                            executor, export_dir)
+                        replica = rep.replica
+                        loads.append(
+                            replica.submit("serve_load", rid, export_dir))
+                        new_reps.append(rep)
+                    errors = []
+                    for f in loads:
+                        try:
+                            f.result(timeout=timeout)
+                        except Exception as e:  # noqa: BLE001 - below
+                            errors.append(e)
+                    if errors:
+                        threading.Thread(
+                            target=self._unload_replicas,
+                            args=(new_reps, v), daemon=True,
+                            name=f"rdt-serve-scalefail-{self.name}").start()
+                        raise ServingError(
+                            f"scale-up of v{v} failed loading "
+                            f"{len(errors)}/{len(loads)} replica(s)"
+                        ) from errors[0]
+                    done: Future = Future()
+                    self._events.put(
+                        ("add_replicas", v, new_reps, rid_seq + count - have,
+                         done))
+                    per_version[v] = done.result(timeout=30.0)
+                elif count < have:
+                    done = Future()
+                    self._events.put(("shrink_group", v, have - count, done))
+                    per_version[v] = done.result(timeout=30.0)
+                else:
+                    per_version[v] = {"replicas": have, "unchanged": True}
+            return {"replicas": count, "versions": per_version}
+
     def serving_report(self) -> Dict[str, Any]:
         """Counters + latency snapshot (the ``shuffle_stage_report`` twin
-        for the serving plane; columns documented in doc/serving.md)."""
+        for the serving plane; columns documented in doc/serving.md),
+        including one row per live VERSION group — requests, failures,
+        p50/p99 over its own window, weight, replica counts — the rollout
+        judgment's input."""
         if self._closed and not self._dispatcher.is_alive():
             return self._report()  # post-close snapshot: nothing mutates
         done: Future = Future()
@@ -439,9 +673,11 @@ class ServingSession:
         self._events.put(("stop",))
         self._dispatcher.join(timeout=30.0)
         if unload:
-            # the active replicas plus any swapped-out version still
-            # draining (the dispatcher is down: nothing retires them now)
-            doomed = list(self._replicas)
+            # every live group's replicas plus any swapped-out version
+            # still draining (the dispatcher is down: nothing retires them
+            # now); single attempt each — the runtime is going away, so
+            # the retry-probe path would just dial a stopping pool
+            doomed = [r for g in self._groups for r in g.replicas]
             for _, reps, _ in self._retiring:
                 doomed.extend(reps)
             self._retiring = []
@@ -477,13 +713,33 @@ class ServingSession:
                         self._on_done(ev[1], ev[2], ev[3], ev[4])
                     elif kind == "replica_up":
                         self._on_replica_up(ev[1], ev[2])
-                    elif kind == "swap_prep":
+                    elif kind in ("swap_prep", "scale_prep"):
                         # a torn mid-rebind (handle, name) pair is what the
                         # dispatcher-thread copy exists to prevent
-                        ev[1].set_result([(r.replica, r.executor)
-                                          for r in self._replicas])
+                        if kind == "swap_prep":
+                            ev[1].set_result(
+                                [(r.replica, r.executor)
+                                 for r in self._primary.replicas])
+                        else:
+                            ev[1].set_result(
+                                [(g.version, g.export_dir, g.rid_seq,
+                                  [(r.replica, r.executor)
+                                   for r in g.replicas])
+                                 for g in self._groups])
                     elif kind == "swap":
                         self._on_swap(ev[1], ev[2], ev[3], ev[4], ev[5])
+                    elif kind == "add_group":
+                        self._on_add_group(ev[1], ev[2])
+                    elif kind == "set_weight":
+                        self._on_set_weight(ev[1], ev[2], ev[3])
+                    elif kind == "promote":
+                        self._on_promote(ev[1], ev[2])
+                    elif kind == "drop_group":
+                        self._on_drop_group(ev[1], ev[2])
+                    elif kind == "add_replicas":
+                        self._on_add_replicas(ev[1], ev[2], ev[3], ev[4])
+                    elif kind == "shrink_group":
+                        self._on_shrink_group(ev[1], ev[2], ev[3])
                     elif kind == "report":
                         ev[1].set_result(self._report())
                 self._flush_batches()
@@ -573,7 +829,11 @@ class ServingSession:
                 if not r.fut.done():
                     r.fut.set_exception(e)
             return
-        d = _Dispatch(next(self._did), payload, rows, parts)
+        # the version is chosen ONCE, at dispatch birth: whatever happens
+        # to this batch later (re-route, hedge, park) stays inside the
+        # chosen version's replica set
+        group = self._choose_version()
+        d = _Dispatch(next(self._did), payload, rows, parts, group.version)
         self._stats["batches"] += 1
         self._stats["rows"] += rows
         metrics.inc("serve_batches_total")
@@ -585,17 +845,58 @@ class ServingSession:
         self._submit(d, hedge=False)
 
     # -- routing --------------------------------------------------------------
+    def _group(self, version: int) -> Optional[_VersionGroup]:
+        for g in self._groups:
+            if g.version == version:
+                return g
+        return None
+
+    def _vlabel(self, g: _VersionGroup) -> str:
+        return f"{self.name}:v{g.version}"
+
+    def _choose_version(self) -> _VersionGroup:
+        """Smooth weighted round-robin over the live version groups: each
+        candidate accrues its weight in credit, the highest credit wins and
+        pays back the total — a deterministic interleave whose long- AND
+        short-run split matches the weight table (nginx's algorithm). A
+        weight-0 group gets nothing; with every weight 0 (transient
+        rollback states) the primary serves."""
+        live = [g for g in self._groups if g.weight > 0 and g.replicas]
+        if not live:
+            return self._primary
+        if len(live) == 1:
+            return live[0]
+        total = 0.0
+        best = None
+        for g in live:
+            g.wrr += g.weight
+            total += g.weight
+            if best is None or g.wrr > best.wrr:
+                best = g
+        best.wrr -= total
+        return best
+
     def _choose(self, d: _Dispatch) -> Optional[_ReplicaState]:
-        """Least-busy ready replica not already carrying this dispatch,
-        round-robin on ties, respecting the per-replica in-flight cap —
-        except when EVERY ready replica is at cap, where the least-busy one
-        is taken anyway (a serving request must queue, not park forever)."""
+        """Least-busy ready replica OF THIS DISPATCH'S VERSION not already
+        carrying it, round-robin on ties, respecting the per-replica
+        in-flight cap — except when EVERY ready replica is at cap, where
+        the least-busy one is taken anyway (a serving request must queue,
+        not park forever). A dispatch whose version group was dropped
+        (rolled back) before any replica answered re-homes to the primary —
+        it was never answered, so no response mixes versions."""
+        g = self._group(d.version)
+        if g is None or not g.replicas:
+            g = self._primary
+            if d.version != g.version:
+                d.version = g.version
+                d.tried.clear()
+        reps = g.replicas
         start = next(self._rr)
-        k = len(self._replicas)
+        k = len(reps)
         best = None
         for allow_full in (False, True):
             for i in range(k):
-                rep = self._replicas[(start + i) % k]
+                rep = reps[(start + i) % k]
                 if not rep.ready or rep.rid in d.tried:
                     continue
                 if not allow_full and rep.inflight >= self._max_inflight:
@@ -669,12 +970,13 @@ class ServingSession:
         # needed: re-kick any reload that previously gave up, so a
         # transient full outage longer than one reload pass does not brick
         # the session for its remaining lifetime
-        for rep in self._replicas:
-            if not rep.ready and not rep.reloading:
-                rep.reloading = True
-                threading.Thread(target=self._reload, args=(rep,),
-                                 daemon=True,
-                                 name=f"rdt-serve-reload-{rep.rid}").start()
+        for g in self._groups:
+            for rep in g.replicas:
+                if not rep.ready and not rep.reloading:
+                    rep.reloading = True
+                    threading.Thread(
+                        target=self._reload, args=(rep,), daemon=True,
+                        name=f"rdt-serve-reload-{rep.rid}").start()
 
     def _retry_parked(self) -> None:
         if not self._parked:
@@ -713,15 +1015,26 @@ class ServingSession:
                 self._batch_lat.append(now - att.t0)
                 if len(self._batch_lat) > _LAT_WINDOW:
                     del self._batch_lat[:-_LAT_WINDOW]
+            g = self._group(d.version)
             preds = np.asarray(fut.result())
             for req, off in d.parts:
                 if not req.fut.done():  # close()/race-failed futures skip
                     req.fut.set_result(preds[off:off + req.rows])
                 self._req_lat.append(now - req.t_enq)
                 metrics.observe("serve_request_seconds", now - req.t_enq)
+                if g is not None:
+                    g.req_lat.append(now - req.t_enq)
+                    metrics.observe("serve_version_request_seconds",
+                                    now - req.t_enq, label=self._vlabel(g))
                 req.finish(replica=rid)
             if len(self._req_lat) > _LAT_WINDOW:
                 del self._req_lat[:-_LAT_WINDOW]
+            if g is not None:
+                g.requests += len(d.parts)
+                metrics.inc("serve_version_requests_total", len(d.parts),
+                            label=self._vlabel(g))
+                if len(g.req_lat) > _LAT_WINDOW:
+                    del g.req_lat[:-_LAT_WINDOW]
             if not d.attempts:
                 self._inflight.pop(did, None)
             return
@@ -745,8 +1058,8 @@ class ServingSession:
             return
         self._stats["rerouted"] += 1
         metrics.inc("serve_rerouted_total")
-        logger.warning("serve dispatch %d re-routing off %s after: %s",
-                       d.id, rep.rid if rep else "?", err)
+        logger.warning("serve dispatch %d (v%d) re-routing off %s after: %s",
+                       d.id, d.version, rep.rid if rep else "?", err)
         self._submit(d, hedge=False)
 
     def _fail_dispatch(self, d: _Dispatch) -> None:
@@ -754,6 +1067,11 @@ class ServingSession:
         self._inflight.pop(d.id, None)
         self._stats["failed"] += len(d.parts)
         metrics.inc("serve_failed_total", len(d.parts))
+        g = self._group(d.version)
+        if g is not None:
+            g.failed += len(d.parts)
+            metrics.inc("serve_version_failed_total", len(d.parts),
+                        label=self._vlabel(g))
         err = ServingError(
             f"request failed on every replica within "
             f"{self._reroute_grace_s:.0f}s (last error: {d.last_error})")
@@ -763,7 +1081,7 @@ class ServingSession:
                 req.fut.set_exception(err)
             req.finish(failed=True)
         metrics.record_event("request_failed", dispatch=d.id,
-                             requests=len(d.parts),
+                             version=d.version, requests=len(d.parts),
                              last_error=str(d.last_error)[:300])
         # the ServingError postmortem bundle (doc/observability.md) — on a
         # BACKGROUND thread: the harvest RPCs every live process with a 10s
@@ -808,11 +1126,13 @@ class ServingSession:
 
     def _reload(self, rep: _ReplicaState) -> None:
         """Background: wait out the executor restart and reload the
-        servable, then hand the replica back to the dispatcher. Routed
-        through the pool's live-member view: an executor that was RETIRED
-        (drained out of the session) never comes back under its old handle,
-        so the replica re-binds onto a surviving member and loads there —
-        probing the corpse until the grace expired was exactly the
+        servable, then hand the replica back to the dispatcher. Reloads the
+        replica's OWN bundle (``rep.export_dir``) — a canary replica must
+        come back as the canary, not as whatever the primary moved to.
+        Routed through the pool's live-member view: an executor that was
+        RETIRED (drained out of the session) never comes back under its old
+        handle, so the replica re-binds onto a surviving member and loads
+        there — probing the corpse until the grace expired was exactly the
         fixed-identity bug this replaces."""
         deadline = time.monotonic() + self._reroute_grace_s
         last: Optional[BaseException] = None
@@ -822,7 +1142,7 @@ class ServingSession:
                 return  # session gone: stop dialing a stopped runtime
             try:
                 replica = rep.replica
-                replica.call("serve_load", rep.rid, self.export_dir,
+                replica.call("serve_load", rep.rid, rep.export_dir,
                              timeout=60.0)
                 self._events.put(("replica_up", rep, None))
                 return
@@ -849,6 +1169,9 @@ class ServingSession:
         except Exception:  # noqa: BLE001 - a stopping session reads as none
             return []
 
+    def _all_replicas(self) -> List[_ReplicaState]:
+        return [r for g in self._groups for r in g.replicas]
+
     def _maybe_rebind(self, rep: _ReplicaState, fails: int) -> bool:
         """Re-home a reloading replica whose executor left the pool: once
         the bound executor is no longer a live member (retired/reaped), or
@@ -868,11 +1191,12 @@ class ServingSession:
         if still_member and fails < 4:
             return False
         counts: Dict[str, int] = {}
-        for r in self._replicas:
+        all_reps = self._all_replicas()
+        for r in all_reps:
             counts[r.executor] = counts.get(r.executor, 0) + 1
         target = min(live, key=lambda h: (counts.get(h.name, 0)
                                           if h.name != rep.executor
-                                          else len(self._replicas) + 1))
+                                          else len(all_reps) + 1))
         if target.name == rep.executor:
             return False
         logger.warning("replica %s re-homing from %s executor %s to %s",
@@ -901,35 +1225,164 @@ class ServingSession:
                                  executor=rep.executor)
             logger.info("replica %s reloaded and back in rotation", rep.rid)
 
-    # -- hot swap (dispatcher side) -------------------------------------------
+    # -- hot swap / version lifecycle (dispatcher side) -----------------------
     def _on_swap(self, new_reps: List[_ReplicaState], export_dir: str,
                  version: int, tag: Optional[str], done: Future) -> None:
         """The atomic half of :meth:`hot_swap`: one dispatcher step swaps
-        the routing table, so a dispatch either chose the old version or
-        the new one — never a mix, never a gap."""
-        old = self._replicas
-        self._replicas = new_reps
+        the primary group, so a dispatch either chose the old version or
+        the new one — never a mix, never a gap. Canary groups (if any)
+        keep their weights and replicas."""
+        old = self._primary
+        group = _VersionGroup(version, export_dir, tag, new_reps,
+                              weight=old.weight)
+        self._groups[self._groups.index(old)] = group
+        self._primary = group
         self.export_dir = export_dir
-        self._version = version
-        self._active_tag = tag
         self._swaps += 1
         self._retiring.append(
-            (time.monotonic() + self._swap_drain_s, old, version - 1))
+            (time.monotonic() + self._swap_drain_s, old.replicas,
+             old.version))
         metrics.inc("serve_hot_swaps_total")
         metrics.record_event("hot_swap", session=self.name, version=version,
                              export_dir=export_dir, tag=tag or "")
         logger.info("serving session %s hot-swapped to v%d (%s%s); v%d "
                     "retiring behind %d in-flight dispatch(es)", self.name,
                     version, export_dir, f", tag={tag}" if tag else "",
-                    version - 1, sum(r.inflight for r in old))
+                    old.version, sum(r.inflight for r in old.replicas))
         done.set_result({"version": version, "export_dir": export_dir,
                          "tag": tag,
                          "replicas": [r.rid for r in new_reps]})
 
+    def _on_add_group(self, group: _VersionGroup, done: Future) -> None:
+        self._groups.append(group)
+        metrics.set_gauge("serve_version_weight", group.weight,
+                          label=self._vlabel(group))
+        metrics.set_gauge("serve_version_replicas", len(group.replicas),
+                          label=self._vlabel(group))
+        logger.info("serving session %s added v%d (%s) at weight %.3g "
+                    "(%d replica(s))", self.name, group.version,
+                    group.export_dir, group.weight, len(group.replicas))
+        done.set_result({"version": group.version,
+                         "export_dir": group.export_dir,
+                         "tag": group.tag, "weight": group.weight,
+                         "replicas": [r.rid for r in group.replicas]})
+
+    def _on_set_weight(self, version: int, weight: float,
+                       done: Future) -> None:
+        g = self._group(version)
+        if g is None:
+            done.set_exception(ServingError(
+                f"no live version v{version} in session {self.name!r}"))
+            return
+        g.weight = weight
+        # fresh credit all around: the new split starts NOW, not after the
+        # old credits drain through
+        for grp in self._groups:
+            grp.wrr = 0.0
+        metrics.set_gauge("serve_version_weight", weight,
+                          label=self._vlabel(g))
+        done.set_result({"version": version, "weight": weight})
+
+    def _on_promote(self, version: int, done: Future) -> None:
+        g = self._group(version)
+        if g is None:
+            done.set_exception(ServingError(
+                f"no live version v{version} to promote"))
+            return
+        if g is self._primary:
+            done.set_result({"version": version, "already_primary": True})
+            return
+        old = self._primary
+        self._groups.remove(old)
+        g.weight = 1.0
+        g.wrr = 0.0
+        self._primary = g
+        self.export_dir = g.export_dir
+        self._swaps += 1
+        self._retiring.append(
+            (time.monotonic() + self._swap_drain_s, old.replicas,
+             old.version))
+        metrics.inc("serve_hot_swaps_total")
+        metrics.set_gauge("serve_version_weight", 1.0, label=self._vlabel(g))
+        metrics.set_gauge("serve_version_weight", 0.0,
+                          label=self._vlabel(old))
+        metrics.record_event("hot_swap", session=self.name,
+                             version=g.version, export_dir=g.export_dir,
+                             tag=g.tag or "", promoted=True)
+        logger.info("serving session %s promoted v%d to primary; v%d "
+                    "retiring behind %d in-flight dispatch(es)", self.name,
+                    g.version, old.version,
+                    sum(r.inflight for r in old.replicas))
+        done.set_result({"version": g.version, "export_dir": g.export_dir,
+                         "tag": g.tag, "retired": old.version})
+
+    def _on_drop_group(self, version: int, done: Future) -> None:
+        g = self._group(version)
+        if g is None:
+            done.set_exception(ServingError(
+                f"no live version v{version} to drop"))
+            return
+        if g is self._primary:
+            done.set_exception(ServingError(
+                "cannot drop the primary version; promote another first"))
+            return
+        self._groups.remove(g)
+        self._retiring.append(
+            (time.monotonic() + self._swap_drain_s, g.replicas, g.version))
+        metrics.set_gauge("serve_version_weight", 0.0,
+                          label=self._vlabel(g))
+        metrics.set_gauge("serve_version_replicas", 0,
+                          label=self._vlabel(g))
+        logger.info("serving session %s dropped v%d (%d replica(s) "
+                    "retiring)", self.name, version, len(g.replicas))
+        done.set_result({"version": version,
+                         "requests": g.requests, "failed": g.failed,
+                         "replicas": [r.rid for r in g.replicas]})
+
+    def _on_add_replicas(self, version: int, reps: List[_ReplicaState],
+                         rid_seq: int, done: Future) -> None:
+        g = self._group(version)
+        if g is None:
+            # the group was dropped between the blocking load and this
+            # step: retire the freshly loaded replicas instead of leaking
+            self._retiring.append((time.monotonic(), reps, version))
+            done.set_exception(ServingError(
+                f"version v{version} disappeared during scale-up"))
+            return
+        g.replicas.extend(reps)
+        g.rid_seq = max(g.rid_seq, rid_seq)
+        metrics.set_gauge("serve_version_replicas", len(g.replicas),
+                          label=self._vlabel(g))
+        done.set_result({"version": version, "replicas": len(g.replicas),
+                         "added": [r.rid for r in reps]})
+
+    def _on_shrink_group(self, version: int, n: int, done: Future) -> None:
+        g = self._group(version)
+        if g is None:
+            done.set_exception(ServingError(
+                f"no live version v{version} to shrink"))
+            return
+        n = min(n, max(0, len(g.replicas) - 1))  # never below one replica
+        # drain the least-busy first (ready replicas with work pending are
+        # the ones actually carrying the load); not-ready replicas are the
+        # cheapest victims of all
+        victims = sorted(g.replicas,
+                         key=lambda r: (r.ready, r.inflight))[:n]
+        for r in victims:
+            g.replicas.remove(r)
+        if victims:
+            self._retiring.append(
+                (time.monotonic() + self._swap_drain_s, victims, version))
+        metrics.set_gauge("serve_version_replicas", len(g.replicas),
+                          label=self._vlabel(g))
+        done.set_result({"version": version, "replicas": len(g.replicas),
+                         "removed": [r.rid for r in victims]})
+
     def _retire_swapped(self) -> None:
-        """Unload swapped-out versions once their in-flight dispatches
-        drained (or the ``RDT_SERVE_SWAP_DRAIN_S`` deadline passed — the
-        straggler requests still complete; only the registry entry goes)."""
+        """Unload swapped-out versions (and scaled-down replicas) once
+        their in-flight dispatches drained (or the ``RDT_SERVE_SWAP_DRAIN_S``
+        deadline passed — the straggler requests still complete; only the
+        registry entry goes)."""
         if not self._retiring:
             return
         keep = []
@@ -947,19 +1400,56 @@ class ServingSession:
         self._retiring = keep
 
     def _unload_replicas(self, reps: List[_ReplicaState], ver: int) -> None:
+        """Unload retired replicas, RETRIED through the reload-probe shape:
+        an executor mid-restart refuses now but answers within the grace,
+        so fire-and-forget here used to leave the servable's weights pinned
+        in the restarted process's RAM forever. An executor that left the
+        pool entirely (retired member) took the registry down with its
+        process — that counts as unloaded. A replica that still refuses at
+        the deadline is counted LOUDLY (``serve_unload_failed_total`` + an
+        ``unload_failed`` event) instead of silently leaking."""
+        deadline = time.monotonic() + self._reroute_grace_s
+        failed = 0
         for rep in reps:
-            try:
-                rep.replica.call("serve_unload", rep.rid, timeout=10.0)
-            except Exception:  # noqa: BLE001 - executor may be gone
-                pass
+            last: Optional[BaseException] = None
+            while True:
+                try:
+                    rep.replica.call("serve_unload", rep.rid, timeout=10.0)
+                    last = None
+                    break
+                except Exception as e:  # noqa: BLE001 - probe the restart
+                    last = e
+                    live = self._live_executors()
+                    if live and rep.executor not in {h.name for h in live}:
+                        # the executor is out of the pool: its process (and
+                        # the replica registry pinning the weights) is gone
+                        last = None
+                        break
+                    if self._closed or time.monotonic() >= deadline:
+                        break
+                    time.sleep(0.5)
+            if last is not None:
+                failed += 1
+                metrics.inc("serve_unload_failed_total")
+                metrics.record_event("unload_failed", session=self.name,
+                                     replica=rep.rid, executor=rep.executor,
+                                     version=ver, error=str(last)[:200])
+                logger.error(
+                    "replica %s (v%d) refused serve_unload on %s within "
+                    "%.0fs — its servable weights stay pinned in that "
+                    "process: %s", rep.rid, ver, rep.executor,
+                    self._reroute_grace_s, last)
         logger.info("serving session %s retired servable v%d "
-                    "(%d replica(s) unloaded)", self.name, ver, len(reps))
+                    "(%d/%d replica(s) unloaded)", self.name, ver,
+                    len(reps) - failed, len(reps))
 
     # -- hedging --------------------------------------------------------------
     def _hedge_deadline(self) -> Optional[float]:
         """Seconds after which an in-flight dispatch earns a hedge, or None
-        while hedging is off / unwarmed / pointless (a single replica)."""
-        if not self._hedge_on or len(self._replicas) < 2:
+        while hedging is off / unwarmed / pointless (no version group holds
+        a second replica to race)."""
+        if not self._hedge_on \
+                or not any(len(g.replicas) >= 2 for g in self._groups):
             return None
         if len(self._batch_lat) < _HEDGE_MIN_SAMPLES:
             return None
@@ -976,6 +1466,12 @@ class ServingSession:
         now = time.monotonic()
         for d in list(self._inflight.values()):
             if d.done or d.hedged or not d.attempts:
+                continue
+            # hedges are VERSION-LOCAL: the duplicate races a sibling of
+            # the same servable, so a canary never answers a baseline
+            # request (and vice versa) through the hedge path
+            g = self._group(d.version)
+            if g is None or len(g.replicas) < 2:
                 continue
             if now - d.t_first >= deadline:
                 # count (and retire) the hedge only once it is really in
@@ -1001,18 +1497,60 @@ class ServingSession:
         # failed == shed (nothing failed except typed rejections)
         out["shed"] = shed
         out["failed"] = out["failed"] + shed
+        primary = self._primary
+        replica_rows = []
+        version_rows = []
+        for g in sorted(self._groups,
+                        key=lambda x: (x is not primary, x.version)):
+            glat = sorted(g.req_lat)
+            version_rows.append({
+                "version": g.version,
+                "export_dir": g.export_dir,
+                "tag": g.tag,
+                "weight": g.weight,
+                "primary": g is primary,
+                "requests": g.requests,
+                "failed": g.failed,
+                # admission sheds precede version choice (no dispatch
+                # exists yet to attribute): charged to the primary, whose
+                # saturation they are
+                "shed": shed if g is primary else 0,
+                "p50_ms": round(_quantile(glat, 0.50) * 1000.0, 3),
+                "p99_ms": round(_quantile(glat, 0.99) * 1000.0, 3),
+                "lat_n": len(glat),
+                "replicas": len(g.replicas),
+                "ready": sum(1 for r in g.replicas if r.ready),
+            })
+            for r in g.replicas:
+                replica_rows.append({
+                    "replica": r.rid,
+                    "version": g.version,
+                    "executor": r.executor,
+                    "ready": r.ready,
+                    "requests": r.requests,
+                    "batches": r.batches,
+                    "rows": r.rows,
+                    "hedges": r.hedges,
+                    "inflight": r.inflight,
+                    "inflight_peak": r.inflight_peak,
+                    "reloads": r.reloads,
+                })
         out.update({
-            # which model answers right now: the active servable's version,
-            # bundle dir, and the tag the swapper attached (partial_fit's
-            # source epoch) — what the bench/chaos legs assert on
-            "servable": {"version": self._version,
-                         "export_dir": self.export_dir,
-                         "tag": self._active_tag},
+            # which model answers the PRIMARY traffic right now: the active
+            # servable's version, bundle dir, and the tag the swapper
+            # attached (partial_fit's source epoch) — what the bench/chaos
+            # legs assert on
+            "servable": {"version": primary.version,
+                         "export_dir": primary.export_dir,
+                         "tag": primary.tag},
             "hot_swaps": self._swaps,
+            "versions": version_rows,
             "retiring_replicas": sum(len(reps)
                                      for _, reps, _ in self._retiring),
             "outstanding": outstanding,
             "max_queue": self._max_queue,
+            "max_inflight": self._max_inflight,
+            "shedding": self._max_queue > 0 and outstanding >= self._max_queue,
             "p50_ms": round(_quantile(lat, 0.50) * 1000.0, 3),
             "p99_ms": round(_quantile(lat, 0.99) * 1000.0, 3),
             "mean_batch_occupancy": (round(sum(occ) / len(occ), 2)
@@ -1020,18 +1558,7 @@ class ServingSession:
             "max_batch_occupancy": max(occ) if occ else 0,
             "queue_depth": len(self._pending) + len(self._inflight),
             "queue_depth_peak": self._queue_depth_peak,
-            "replicas": [{
-                "replica": r.rid,
-                "executor": r.executor,
-                "ready": r.ready,
-                "requests": r.requests,
-                "batches": r.batches,
-                "rows": r.rows,
-                "hedges": r.hedges,
-                "inflight": r.inflight,
-                "inflight_peak": r.inflight_peak,
-                "reloads": r.reloads,
-            } for r in self._replicas],
+            "replicas": replica_rows,
         })
         return out
 
@@ -1061,20 +1588,32 @@ class ServingSession:
                 if not ev[1].fut.done():
                     ev[1].fut.set_exception(err)
                 ev[1].finish(failed=True)
-            elif ev[0] == "swap_prep":
+            elif ev[0] in ("swap_prep", "scale_prep"):
                 if not ev[1].done():
                     ev[1].set_exception(
                         ServingError("serving session closed mid-swap"))
-            elif ev[0] == "swap":
-                # the new version DID load on the replicas: unload it (in
-                # the background — these are RPCs) instead of leaving its
-                # weights pinned in executor RAM forever
+            elif ev[0] in ("swap", "add_group", "add_replicas"):
+                # the new version/replicas DID load on the executors:
+                # unload them (in the background — these are RPCs) instead
+                # of leaving their weights pinned in executor RAM forever
+                if ev[0] == "swap":
+                    reps, ver, done = ev[1], ev[3], ev[5]
+                elif ev[0] == "add_group":
+                    reps, ver, done = ev[1].replicas, ev[1].version, ev[2]
+                else:
+                    reps, ver, done = ev[2], ev[1], ev[4]
                 threading.Thread(
-                    target=self._unload_replicas, args=(ev[1], ev[3]),
+                    target=self._unload_replicas, args=(reps, ver),
                     daemon=True,
                     name=f"rdt-serve-drainswap-{self.name}").start()
-                if not ev[5].done():
-                    ev[5].set_exception(
+                if not done.done():
+                    done.set_exception(
                         ServingError("serving session closed mid-swap"))
+            elif ev[0] in ("set_weight", "promote", "drop_group",
+                           "shrink_group"):
+                done = ev[-1]
+                if not done.done():
+                    done.set_exception(
+                        ServingError("serving session closed"))
             elif ev[0] == "report":
                 ev[1].set_result(self._report())

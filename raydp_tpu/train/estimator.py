@@ -21,6 +21,11 @@ class OnlineTrainingResult:
     history: List[Dict[str, float]] = field(default_factory=list)
     exports: List[Tuple[int, str]] = field(default_factory=list)
     epochs: int = 0
+    #: guarded-rollout outcome records, one per export shipped through
+    #: ``rollout=`` (empty when exports hot-swap unguarded); a
+    #: ``rolled_back`` entry means that epoch's model never took traffic —
+    #: training continued past it by design
+    rollouts: List[Dict] = field(default_factory=list)
 
     @property
     def final_metrics(self) -> Dict[str, float]:
@@ -52,6 +57,7 @@ class EstimatorInterface(ABC):
                     export_every: Optional[int] = None,
                     export_dir: Optional[str] = None,
                     serving=None,
+                    rollout: Optional[bool] = None,
                     timeout_s: Optional[float] = None
                     ) -> OnlineTrainingResult:
         """Online training over a continuous pipeline (doc/streaming.md).
@@ -74,14 +80,24 @@ class EstimatorInterface(ABC):
         Every ``export_every`` epochs (default ``RDT_STREAM_EXPORT_EVERY``;
         0 disables) the current model is ``export_serving``-ed under
         ``export_dir/v<n>`` and — when ``serving`` (a live
-        :class:`~raydp_tpu.serve.ServingSession`) is attached — hot-swapped
-        into it under live traffic, tagged with the source epoch id.
+        :class:`~raydp_tpu.serve.ServingSession`) is attached — shipped
+        into it under live traffic, tagged with the source epoch id:
+        either an immediate atomic :meth:`hot_swap`, or, with
+        ``rollout=True`` (default ``RDT_STREAM_ROLLOUT``), a GUARDED
+        rollout — canary weight, ramp, per-version health judgment,
+        auto-promote or auto-rollback (doc/serving.md "Guarded
+        rollouts"). A rolled-back export does NOT stop training: the
+        outcome lands in ``result.rollouts`` and the next epoch trains
+        on — shipping a bad epoch to 100% of traffic is the failure mode
+        the guard exists for, a bad epoch itself is routine.
         Stops after ``max_epochs``, or when the stream ends.
         """
         from raydp_tpu import knobs, metrics
 
         if export_every is None:
             export_every = int(knobs.get("RDT_STREAM_EXPORT_EVERY"))
+        if rollout is None:
+            rollout = bool(knobs.get("RDT_STREAM_ROLLOUT"))
         if export_every and export_dir is None:
             export_dir = tempfile.mkdtemp(prefix="rdt-online-")
         result = OnlineTrainingResult()
@@ -100,7 +116,12 @@ class EstimatorInterface(ABC):
                 self.export_serving(vdir)
                 result.exports.append((epoch_id, vdir))
                 if serving is not None:
-                    serving.hot_swap(vdir, tag=f"epoch-{epoch_id}")
+                    tag = f"epoch-{epoch_id}"
+                    if rollout:
+                        result.rollouts.append(
+                            serving.rollout(vdir, tag=tag))
+                    else:
+                        serving.hot_swap(vdir, tag=tag)
         return result
 
     @staticmethod

@@ -1136,6 +1136,237 @@ def test_serving_replica_crash_reroutes_zero_dropped(tmp_path):
     assert np.array_equal(results["clean"], results["crash"])
 
 
+# ==== guarded rollouts under chaos (ISSUE 18) ================================
+
+def _guard_traffic(srv, x, n, out, timeout=120.0):
+    """Sequential seeded load for the rollout legs: ``n`` 2-row predicts in
+    a FIXED order, responses appended in that order — two runs (with and
+    without a rollout in flight) produce position-comparable sequences."""
+    for i in range(n):
+        j = (2 * i) % 400
+        out.append(srv.predict({"x1": x[j:j + 2, 0],
+                                "x2": x[j:j + 2, 1]}, timeout=timeout))
+
+
+def test_rollout_canary_latency_regression_rolls_back(tmp_path):
+    """ISSUE 18 chaos leg (a): a canary whose every predict is stalled by a
+    seeded ``serve.predict:delay`` (replica-id match ``-v2-`` pins the
+    injection to the canary group alone) is judged unhealthy on the p99 arm
+    and AUTO-ROLLS-BACK mid-traffic: zero dropped requests, results
+    byte-identical to a rollout-free run, and the postmortem artifacts — a
+    ``rollout_rollback`` event plus a flight-recorder blackbox bundle — are
+    present. The delay rule has no once= sentinel (it must fire on every
+    canary call to regress the p99 window); the ``"p99"`` rollback reason is
+    the proof the injection bit."""
+    import optax
+
+    from raydp_tpu import metrics
+    from raydp_tpu.models import MLP
+    from raydp_tpu.runtime import head as head_mod
+    from raydp_tpu.serve import ServingSession
+    from raydp_tpu.train import FlaxEstimator
+
+    rng = np.random.RandomState(11)
+    x = rng.random_sample((512, 2))
+    y = x @ np.array([2.0, -3.0]) + 1.0
+    pdf = pd.DataFrame({"x1": x[:, 0], "x2": x[:, 1], "y": y})
+    dir_v1 = str(tmp_path / "guard-v1")
+    dir_v2 = str(tmp_path / "guard-v2")
+    results, reports = {}, {}
+    outcome = None
+
+    for mode in ("clean", "rollout"):
+        if mode == "rollout":
+            # EVERY canary predict (replica ids guard-v2-r*) stalls 700ms —
+            # a pure latency regression (no errors): only the p99 arm can
+            # catch it (env set BEFORE init so executors inherit it). The
+            # stall dwarfs any host-noise inflation of the baseline p99: a
+            # loaded suite run must still clear the 2x judgment bar, or the
+            # verdict would flap healthy and ramp a genuinely slow canary.
+            os.environ["RDT_FAULTS"] = \
+                "serve.predict:delay:ms=700:match=-v2-"
+        os.environ["RDT_SERVE_BATCH_TIMEOUT_MS"] = "10"
+        os.environ["RDT_SERVE_HEDGE"] = "0"
+        s = _session(f"serve_rollout_{mode}")
+        try:
+            if mode == "clean":
+                df = s.createDataFrame(pdf, num_partitions=2)
+                est = FlaxEstimator(
+                    model=MLP(features=(8,), use_batch_norm=False),
+                    optimizer=optax.adam(1e-2), loss="mse",
+                    feature_columns=["x1", "x2"], label_column="y",
+                    batch_size=64, num_epochs=1)
+                est.fit_on_frame(df)
+                est.export_serving(dir_v1)
+                # the canary is the SAME weights exported again: responses
+                # must be byte-identical whichever version answers, so the
+                # identity assert covers requests served mid-ramp too
+                est.export_serving(dir_v2)
+            srv = ServingSession(dir_v1, session=s, name="guard")
+            try:
+                got = []
+                t = threading.Thread(target=_guard_traffic,
+                                     args=(srv, x, 120, got))
+                t.start()
+                try:
+                    if mode == "rollout":
+                        outcome = srv.rollout(
+                            dir_v2, tag="regressed", initial_weight=0.5,
+                            steps=[0.5, 1.0], step_s=20.0, min_samples=6,
+                            p99_factor=2.0, timeout=120.0)
+                finally:
+                    t.join(timeout=180.0)
+                assert not t.is_alive(), "traffic thread hung"
+                results[mode] = np.concatenate(got)
+                reports[mode] = srv.serving_report()
+                if mode == "rollout":
+                    # postmortem artifacts, checked while the session (and
+                    # its session_dir) is live
+                    kinds = [e["kind"] for e in metrics.events()]
+                    assert "rollout_rollback" in kinds, kinds
+                    bb_dir = os.path.join(
+                        head_mod.get_runtime().session_dir, "blackbox")
+                    bundles = [f for f in os.listdir(bb_dir)
+                               if f.startswith("blackbox-rollout-guard")
+                               and f.endswith(".json")]
+                    assert bundles, "rollback wrote no blackbox bundle"
+            finally:
+                srv.close()
+        finally:
+            raydp_tpu.stop()
+            os.environ.pop("RDT_FAULTS", None)
+            os.environ.pop("RDT_SERVE_BATCH_TIMEOUT_MS", None)
+            os.environ.pop("RDT_SERVE_HEDGE", None)
+
+    # the guard judged the latency regression, not an error burst
+    assert outcome["outcome"] == "rolled_back", outcome
+    assert "p99" in outcome["reason"], outcome
+    # zero dropped: every seeded request completed, none failed terminally
+    assert reports["rollout"]["failed"] == 0, reports["rollout"]
+    assert len(results["rollout"]) == len(results["clean"]) == 240
+    # byte-identical to the rollout-free run: neither the canary detour nor
+    # the rollback re-home may leak into the numbers
+    assert np.array_equal(results["clean"], results["rollout"])
+    # the canary group is gone: the primary (v1) is the only live version
+    # and no replica still carries the canary's bundle
+    rep = reports["rollout"]
+    assert rep["servable"]["version"] == 1, rep["servable"]
+    assert [vr["version"] for vr in rep["versions"]] == [1], rep["versions"]
+    assert all(r["version"] == 1 for r in rep["replicas"]), rep["replicas"]
+
+
+def test_rollout_canary_executor_crash_mid_ramp_stays_unmixed(tmp_path):
+    """ISSUE 18 chaos leg (b): the canary's executor CRASHES mid-ramp
+    (``nth=2`` on replica guardb-v2-r0, once= sentinel). The in-flight
+    dispatch re-routes VERSION-LOCALLY to the canary's surviving sibling —
+    the ramp then continues or rolls back on its own judgment, but no
+    response ever mixes versions: every answer is checked row-for-row
+    against locally computed reference predictions of model A and model B
+    (two genuinely different trainings) and must equal exactly one of
+    them."""
+    import optax
+
+    from raydp_tpu.models import MLP
+    from raydp_tpu.serve import ServingSession, load_servable
+    from raydp_tpu.train import FlaxEstimator
+
+    rng = np.random.RandomState(11)
+    x = rng.random_sample((512, 2))
+    y = x @ np.array([2.0, -3.0]) + 1.0
+    pdf = pd.DataFrame({"x1": x[:, 0], "x2": x[:, 1], "y": y})
+    dir_a = str(tmp_path / "guardb-a")
+    dir_b = str(tmp_path / "guardb-b")
+    sentinel = str(tmp_path / "rollout_crash.sentinel")
+
+    # the 2nd batch entering canary replica guardb-v2-r0 kills its executor
+    # abruptly mid-request; the primary replica colocated on that executor
+    # dies with it (both groups must re-route, each within its own version)
+    os.environ["RDT_FAULTS"] = (
+        f"serve.predict:crash:nth=2:match=|guardb-v2-r0:once={sentinel}")
+    os.environ["RDT_SERVE_BATCH_TIMEOUT_MS"] = "10"
+    os.environ["RDT_SERVE_HEDGE"] = "0"
+    s = _session("serve_rollout_crash")
+    try:
+        df = s.createDataFrame(pdf, num_partitions=2)
+        # two genuinely different models: more epochs move the weights, and
+        # the refs-differ assert below keeps the mixing check non-vacuous
+        est_a = FlaxEstimator(
+            model=MLP(features=(8,), use_batch_norm=False),
+            optimizer=optax.adam(1e-2), loss="mse",
+            feature_columns=["x1", "x2"], label_column="y",
+            batch_size=64, num_epochs=1)
+        est_a.fit_on_frame(df)
+        est_a.export_serving(dir_a)
+        est_b = FlaxEstimator(
+            model=MLP(features=(8,), use_batch_norm=False),
+            optimizer=optax.adam(1e-2), loss="mse",
+            feature_columns=["x1", "x2"], label_column="y",
+            batch_size=64, num_epochs=4)
+        est_b.fit_on_frame(df)
+        est_b.export_serving(dir_b)
+
+        # per-request reference predictions, computed locally through the
+        # SAME servable decode/place/apply path the replicas run
+        sv_a, sv_b = load_servable(dir_a), load_servable(dir_b)
+        batches = []
+        refs_a, refs_b = [], []
+        for i in range(120):
+            j = (2 * i) % 400
+            tbl = pa.table({"x1": x[j:j + 2, 0], "x2": x[j:j + 2, 1]})
+            batches.append(j)
+            refs_a.append(sv_a.predict_table(tbl))
+            refs_b.append(sv_b.predict_table(tbl))
+        assert not np.array_equal(refs_a[0], refs_b[0]), \
+            "models A and B predict identically; mixing check is vacuous"
+
+        srv = ServingSession(dir_a, session=s, name="guardb")
+        try:
+            got = []
+            t = threading.Thread(target=_guard_traffic,
+                                 args=(srv, x, 120, got))
+            t.start()
+            try:
+                outcome = srv.rollout(
+                    dir_b, tag="crashy-host", initial_weight=0.5,
+                    steps=[0.5, 1.0], step_s=10.0, min_samples=4,
+                    timeout=180.0)
+            finally:
+                t.join(timeout=240.0)
+            assert not t.is_alive(), "traffic thread hung"
+            report = srv.serving_report()
+        finally:
+            srv.close()
+    finally:
+        raydp_tpu.stop()
+        os.environ.pop("RDT_FAULTS", None)
+        os.environ.pop("RDT_SERVE_BATCH_TIMEOUT_MS", None)
+        os.environ.pop("RDT_SERVE_HEDGE", None)
+
+    # the injection actually fired, mid-ramp
+    assert os.path.exists(sentinel), "crash schedule never fired"
+    # zero dropped: the crashed dispatch re-routed (version-locally) and
+    # completed; the ramp reached a terminal verdict on its own
+    assert outcome["outcome"] in ("promoted", "rolled_back"), outcome
+    assert report["failed"] == 0, report
+    assert report["rerouted"] >= 1, report
+    assert len(got) == 120
+    # NO response mixes versions: each answer equals model A's reference or
+    # model B's reference for its batch, entirely
+    from_a = from_b = 0
+    for i, ans in enumerate(got):
+        if np.array_equal(ans, refs_a[i]):
+            from_a += 1
+        elif np.array_equal(ans, refs_b[i]):
+            from_b += 1
+        else:
+            raise AssertionError(
+                f"response {i} (batch offset {batches[i]}) matches neither "
+                f"version's reference — versions mixed in one response")
+    # both versions actually took traffic (the canary held >= min_samples
+    # requests before any terminal verdict)
+    assert from_a >= 1 and from_b >= 1, (from_a, from_b)
+
+
 # ==== multi-tenant overload robustness (ISSUE 14) ============================
 
 def _wide_pdf(n=16000):

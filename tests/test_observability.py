@@ -262,7 +262,7 @@ def test_rpc_dispatch_installs_caller_context():
     try:
         with profiler.trace("etl:action", "driver"):
             driver_ctx = profiler.capture()
-            client.call("work", timeout=10.0)
+            client.call("telemetry", timeout=10.0)
         assert seen["ctx"] == driver_ctx
         remote = [s for s in profiler.spans() if s["name"] == "stage:run"][0]
         assert remote["tr"] == driver_ctx[0]
@@ -296,7 +296,7 @@ def test_rpc_deferred_reply_worker_thread_keeps_context():
     try:
         with profiler.trace("stage:run", "etl"):
             driver_ctx = profiler.capture()
-            worker_ctx = client.call("work", timeout=10.0)
+            worker_ctx = client.call("telemetry", timeout=10.0)
         assert worker_ctx == driver_ctx
         task = [s for s in profiler.spans() if s["name"] == "task:"][0]
         assert task["par"] == driver_ctx[1]
