@@ -21,7 +21,17 @@ shrinks the pool between ``RDT_POOL_MIN`` and ``RDT_POOL_MAX``:
   then the node agent reaps the process.
 - **hysteresis**: ``RDT_POOL_COOLDOWN_S`` after any scale event, plus the
   sustained windows above, so scale-up and the load it sheds cannot chase
-  each other.
+  each other. One signal pierces BOTH dampeners: PARKED admission demand.
+  Admission parks an action only after the backlog bound is already
+  exceeded, so the demand is proven — a post-shrink cooldown that kept
+  parked work waiting would be self-inflicted queueing delay.
+- **predictive sizing**: a grow decision targets the demand it can see
+  instead of stepping +1 — one slot per parked admission, and (when
+  ``RDT_POOL_BYTES_PER_EXEC`` is set) enough executors for the AQE
+  plane's measured per-stage bytes. Each tick also feeds those measured
+  bytes to the store's budget derivation (:meth:`Engine.
+  derive_store_budgets`), so eviction pressure tracks the plan the
+  engine is actually running.
 
 The ``pool.scale`` fault site fires at every scale decision (key:
 ``"up"``/``"down"``); ``delay`` models a slow spawn/control plane.
@@ -65,6 +75,7 @@ class PoolAutoscaler:
         self._cooldown_until = 0.0
         self._queued_since: Optional[float] = None
         self._idle_since: Optional[float] = None
+        self._parked_since: Optional[float] = None
         self.events: List[Dict[str, Any]] = []
         self._events_cap = 256
 
@@ -125,6 +136,13 @@ class PoolAutoscaler:
         if engine is None:
             return  # session not started (or already torn down)
         pool = engine.pool
+        # AQE store-budget feed: re-derive per-host budgets from the stage
+        # ledger's measured bytes (no-op when RDT_STORE_AQE_BUDGET is off,
+        # the ledger is empty, or the measurement has not changed); getattr:
+        # unit harnesses drive the controller against bare engine stubs
+        derive = getattr(engine, "derive_store_budgets", None)
+        if derive is not None:
+            derive()
         load = pool.load()
         now = time.monotonic()
         live = load["live"]
@@ -141,11 +159,26 @@ class PoolAutoscaler:
         else:
             self._queued_since = None
             self._idle_since = None
-        if now < self._cooldown_until:
+        parked = int(load.get("parked", 0) or 0)
+        if parked > 0:
+            self._parked_since = self._parked_since or now
+        else:
+            self._parked_since = None
+        # PARKED admission demand pierces both dampeners (the post-scale
+        # cooldown and the sustained-queue window): admission parks an
+        # action only once the backlog bound is already exceeded, so the
+        # demand signal is proven — the hysteresis that protects against
+        # recovery spikes does not apply. One PRIOR tick of parked demand
+        # is still required (strictly older than this tick), so the gap
+        # between a finished grow and admission's unpark can't double-spawn.
+        parked_grow = (parked > 0 and live < mx
+                       and self._parked_since is not None
+                       and self._parked_since < now)
+        if now < self._cooldown_until and not parked_grow:
             return
-        if self._queued_since is not None and live < mx \
-                and now - self._queued_since \
-                >= float(knobs.get("RDT_POOL_SCALE_UP_S")):
+        if parked_grow or (self._queued_since is not None and live < mx
+                           and now - self._queued_since
+                           >= float(knobs.get("RDT_POOL_SCALE_UP_S"))):
             self._grow(load, live)
         elif self._idle_since is not None and live > mn \
                 and now - self._idle_since \
@@ -157,6 +190,7 @@ class PoolAutoscaler:
             float(knobs.get("RDT_POOL_COOLDOWN_S"))
         self._queued_since = None
         self._idle_since = None
+        self._parked_since = None
         ev = {"ts": time.time(), "direction": direction, "size": size,
               "reason": reason}
         self.events.append(ev)
@@ -179,17 +213,44 @@ class PoolAutoscaler:
 
     def _grow(self, load: Dict[str, Any], live: int) -> None:
         self._apply_scale_fault("up", live)
-        reason = f"queued={load['queued']} busy={load['busy']}"
+        target = self._grow_target(load, live)
+        reason = (f"queued={load['queued']} busy={load['busy']} "
+                  f"parked={load.get('parked', 0)} target={target}")
         logger.info("autoscale: growing pool %d -> %d (%s)",
-                    live, live + 1, reason)
-        handle = self._session._grow_executor()
-        if handle is None:
-            # spawn/readiness failed: cool down anyway so a broken control
-            # plane is retried at the hysteresis cadence, not every tick
+                    live, target, reason)
+        grown = 0
+        for _ in range(target - live):
+            handle = self._session._grow_executor()
+            if handle is None:
+                # spawn/readiness failed: stop here and cool down so a
+                # broken control plane is retried at the hysteresis
+                # cadence, not every tick
+                break
+            grown += 1
+            metrics.inc("pool_scaled_up_total")
+        if grown == 0:
             self._note("up-failed", live, reason)
             return
-        metrics.inc("pool_scaled_up_total")
-        self._note("up", live + 1, reason)
+        self._note("up", live + grown, reason)
+
+    def _grow_target(self, load: Dict[str, Any], live: int) -> int:
+        """Predictive pool size for one grow decision: at least the classic
+        +1 step, raised to one free slot per PARKED admission (none of them
+        is released until capacity exists) and — when the operator sized
+        ``RDT_POOL_BYTES_PER_EXEC`` — to enough executors for the AQE
+        plane's measured per-stage bytes. Always capped at the max bound."""
+        _, mx = self._bounds()
+        target = live + 1
+        parked = int(load.get("parked", 0) or 0)
+        if parked > 0:
+            target = max(target, live + parked)
+        per_exec = int(knobs.get("RDT_POOL_BYTES_PER_EXEC") or 0)
+        measure = getattr(self._session.engine, "measured_stage_bytes", None)
+        if per_exec > 0 and measure is not None:
+            measured = int(measure() or 0)
+            if measured > 0:
+                target = max(target, -(-measured // per_exec))
+        return min(mx, max(target, live + 1))
 
     def _shrink(self, load: Dict[str, Any], live: int) -> None:
         victim = self._session._shrink_candidate()

@@ -462,6 +462,7 @@ class ExecutorPool:
                     self.hosts_by_name[h.name], []).append(h.name)
         self._rr = 0  # guarded-by: _lock
         self._local_rr: Dict[str, int] = {}  # guarded-by: _lock
+        self._weight_rr = 0  # tie rotation for pick_weighted; guarded-by: _lock
         self._lock = threading.Lock()
         #: pool-WIDE in-flight per ident, across every concurrent run_tasks
         #: call — the drain protocol's quiesce signal and the autoscaler's
@@ -963,6 +964,37 @@ class ExecutorPool:
             self._local_rr[host_id] = i + 1
         return names[i % len(names)]
 
+    def pick_weighted(self, host_weights: Dict[str, float]
+                      ) -> Optional[str]:
+        """Preferred executor from per-host locality weights (data-gravity
+        scheduling): hosts are tried in DESCENDING weight order and the
+        heaviest one that still has a dispatchable member (not draining,
+        not on a memory-backpressured host) wins — when the best host is
+        draining, the runner-up (e.g. the machine holding a spilled
+        copy) takes the task instead of an arbitrary executor. Hosts
+        tied on weight rotate deterministically so tied placements
+        spread. None when no weighted host is dispatchable (dispatch
+        then falls back to least-loaded)."""
+        if not host_weights:
+            return None
+        members, _ = self._dispatch_view()
+        live_hosts = {self.hosts_by_name.get(h.name or "", HEAD_HOST)
+                      for h, _ in members}
+        with self._lock:
+            rr = self._weight_rr
+            self._weight_rr += 1
+        ranked = sorted(host_weights.items(), key=lambda kv: -kv[1])
+        i = 0
+        while i < len(ranked):
+            j = i
+            while j < len(ranked) and ranked[j][1] == ranked[i][1]:
+                j += 1
+            tied = sorted(h for h, _ in ranked[i:j] if h in live_hosts)
+            if tied:
+                return self.pick_local(tied[rr % len(tied)])
+            i = j
+        return None
+
     def run_tasks(
         self,
         tasks: Sequence[T.Task],
@@ -1181,6 +1213,10 @@ class ExecutorPool:
             # the submit reached it: a down-marked executor (a restart the
             # node agent finished mid-action) re-enters placement now
             self._mark_up(ident, handle.name or ident)
+            if preferred is not None and preferred[i] is not None \
+                    and (handle.name or ident) == preferred[i]:
+                # data-gravity hit: the task landed where its bytes live
+                metrics.inc("sched_locality_hits_total")
             _register(fut, i, ident, handle.name or ident, False)
 
         def _maybe_speculate(now: float) -> Optional[float]:
@@ -1559,6 +1595,9 @@ class Engine:
         self._stage_reports: "collections.deque[Dict[str, Any]]" = \
             collections.deque(maxlen=256)
         self._retry_rng = random.Random()  # jitter for recovery resubmits
+        # last measured-bytes figure pushed to the store's budget plane
+        # (derive_store_budgets skips the RPC when unchanged)
+        self._last_budget_measured: Optional[int] = None
 
     # ---- shuffle accounting -------------------------------------------------
     def _record_stage(self, label: str, results: Sequence[Dict[str, Any]],
@@ -1711,6 +1750,73 @@ class Engine:
     def reset_shuffle_stage_report(self) -> None:
         with self._report_lock:
             self._stage_reports.clear()
+
+    # ---- AQE-fed store policy plane ------------------------------------------
+    def measured_stage_bytes(self, window: int = 32) -> int:
+        """Peak measured working set over the last ``window`` ledger
+        entries: per stage, the bytes that entered it plus the bytes it
+        moved through the store (bytes_in + bytes_shuffled). This is the
+        AQE plane's measured-bytes signal — what store budget derivation
+        and predictive autoscaling size from (0 until a stage has run)."""
+        with self._report_lock:
+            entries = list(self._stage_reports)[-max(1, int(window)):]
+        return max((int(e.get("bytes_in") or 0)
+                    + int(e.get("bytes_shuffled") or 0)
+                    for e in entries), default=0)
+
+    def derive_store_budgets(self) -> Optional[Dict[str, int]]:
+        """Feed the stage ledger's measured bytes to the store's budget
+        plane (``ObjectStoreServer.derive_budgets``): per-host budgets
+        re-derive from what stages actually moved instead of only the
+        static ``ENV_STORE_*`` numbers. Gated by ``RDT_STORE_AQE_BUDGET``;
+        skips the RPC when the measured figure has not changed; never
+        raises (a failed derivation leaves the static budgets standing)."""
+        if not bool(knobs.get("RDT_STORE_AQE_BUDGET")):
+            return None
+        measured = self.measured_stage_bytes()
+        if measured <= 0 or measured == self._last_budget_measured:
+            return None
+        try:
+            out = get_client().derive_budgets(measured)
+        except Exception:
+            logger.warning("store budget derivation failed; static budgets "
+                           "stand", exc_info=True)
+            return None
+        self._last_budget_measured = measured
+        return out
+
+    def _push_stage_hints(self, tasks: Sequence[T.Task]) -> List[ObjectRef]:
+        """Pin this stage's input blobs in the store for its duration
+        (stage-aware eviction, doc/etl.md "Store budgets"); returns the
+        refs to unpin when the stage completes. Advisory and best-effort:
+        a store that cannot take hints changes nothing. Deliberately NOT
+        a metadata RPC (the data-plane counters stay comparable)."""
+        if not bool(knobs.get("RDT_STORE_STAGE_HINTS")):
+            return []
+        seen: Dict[str, ObjectRef] = {}
+        for t in tasks:
+            for oid in T.task_input_ids(t):
+                if oid not in seen:
+                    seen[oid] = ObjectRef(id=oid)
+        if not seen:
+            return []
+        refs = list(seen.values())
+        try:
+            get_client().eviction_hints(pin=refs)
+        except Exception:
+            return []
+        return refs
+
+    def _drop_stage_hints(self, refs: List[ObjectRef]) -> None:
+        """The stage completed (or aborted): release its pins — at
+        refcount zero the store demotes the blobs to evict-first (their
+        consumer stage is done with them; LRU breaks ties only)."""
+        if not refs:
+            return
+        try:
+            get_client().eviction_hints(unpin=refs)
+        except Exception:
+            pass
 
     # ---- elastic pool: graceful drain ---------------------------------------
     def retire_executor(self, name: str, rehome=None, reap=None,
@@ -2062,6 +2168,10 @@ class Engine:
         blobs: Optional[List[Optional[bytes]]] = \
             [None] * len(tasks) if lineage_label is not None else None
         notified = [False] * len(tasks)
+        # stage-aware eviction: pin this stage's input blobs for its
+        # duration; the finally demotes them to evict-first (their
+        # consumer is done) whether the stage returns or aborts
+        hinted = self._push_stage_hints(tasks)
 
         def _notify(i: int, r: Dict[str, Any]) -> None:
             if on_task_result is None or notified[i]:
@@ -2137,6 +2247,8 @@ class Engine:
             # raise: free them (the pool already freed its own sub-round's)
             _free_result_refs(results)
             raise
+        finally:
+            self._drop_stage_hints(hinted)
 
     def _attribute_consumer_rpcs(self, tasks: Sequence[T.Task],
                                  results: Sequence[Optional[Dict[str, Any]]],
@@ -2648,10 +2760,19 @@ class Engine:
 
     def _locality(self, ref_lists: Sequence[Sequence[Optional[ObjectRef]]]
                   ) -> List[Optional[str]]:
-        """Preferred executor per ref-reading task: one on the machine holding
-        the most input bytes. One bulk ``locations`` RPC; a no-op on
-        single-machine pools so round-robin balance is untouched. Parity:
-        preferred locations from block owner addresses
+        """Preferred executor per ref-reading task: one on the machine whose
+        RESIDENT bytes dominate the task's inputs — data-gravity weighted
+        (doc/etl.md "Data-gravity scheduling"): bytes whose local copy
+        sits in shared memory count at full weight; bytes whose copy is
+        SPILLED to disk at ``RDT_LOCALITY_SPILLED_WEIGHT`` (the fault-in
+        is paid wherever the task lands, so disk-local placement is a
+        smaller win than shm-local but still beats remote); absent bytes
+        weigh nothing. One bulk ``residency`` RPC (``locations`` when the
+        store predates tiers — weighting then degrades to tier-blind); a
+        no-op on single-machine pools so round-robin balance is
+        untouched. The heaviest host that still has a dispatchable member
+        wins (:meth:`ExecutorPool.pick_weighted`; equal weights rotate).
+        Parity: preferred locations from block owner addresses
         (RayDatasetRDD.scala:48-56, RayDPExecutor.scala:271-287).
 
         A task's entry may hold plain refs, ``(ref, offset, size)`` range
@@ -2692,22 +2813,32 @@ class Engine:
                     r, _ = _norm(item)
                     if r is not None:
                         seen[r.id] = r
-            locs = get_client().locations(list(seen.values()))
+            client = get_client()
+            fetch = getattr(client, "residency", None)
+            if fetch is not None:
+                locs = fetch(list(seen.values()))
+            else:  # tier-blind store: every present byte counts as shm
+                locs = client.locations(list(seen.values()))
         except Exception:
             return [None] * len(ref_lists)
+        spilled_w = max(0.0,
+                        float(knobs.get("RDT_LOCALITY_SPILLED_WEIGHT")))
         preferred: List[Optional[str]] = []
         for refs in ref_lists:
-            weight: Dict[str, int] = {}
+            weight: Dict[str, float] = {}
             for item in _flat(refs):
                 r, w = _norm(item)
-                host = locs.get(r.id) if r is not None else None
-                if host is not None:
-                    weight[host] = weight.get(host, 0) + w
-            if not weight:
-                preferred.append(None)
-                continue
-            best = max(weight, key=weight.get)
-            preferred.append(self.pool.pick_local(best))
+                loc = locs.get(r.id) if r is not None else None
+                if loc is None:
+                    continue
+                if isinstance(loc, (tuple, list)):
+                    host, tier = loc[0], loc[1]
+                else:
+                    host, tier = loc, "shm"
+                scaled = w * (spilled_w if tier == "spilled" else 1.0)
+                if scaled > 0:
+                    weight[host] = weight.get(host, 0.0) + scaled
+            preferred.append(self.pool.pick_weighted(weight))
         return preferred
 
     def _compile_csv(self, node: P.CsvScan):

@@ -12,6 +12,7 @@ RayAppMaster.scala:192-209); our executor does the same through
 
 from __future__ import annotations
 
+import os
 import threading
 import uuid
 from typing import Any, Dict, List, Optional
@@ -19,7 +20,7 @@ from typing import Any, Dict, List, Optional
 import cloudpickle
 import pyarrow as pa
 
-from raydp_tpu import faults
+from raydp_tpu import faults, knobs
 from raydp_tpu.etl import tasks as T
 from raydp_tpu.log import get_logger
 from raydp_tpu.runtime.actor import current_actor_context
@@ -190,6 +191,15 @@ class EtlExecutor:
 
     def get_executor_id(self) -> Optional[str]:
         return self.executor_id
+
+    def spawn_info(self) -> Dict[str, Any]:
+        """Spawn provenance: ``warm_forked`` is True when this process was
+        forked from the pre-imported warm-start prototype (the warm plane
+        injects RDT_WARM_FORKED into the child env) rather than cold-spawned
+        — the gravity bench's readiness audit reads this to prove the warm
+        path actually served the scale-up."""
+        return {"executor": self._actor_name, "pid": os.getpid(),
+                "warm_forked": bool(knobs.get("RDT_WARM_FORKED"))}
 
     # -- compute ---------------------------------------------------------------
     def run_task(self, task_bytes: bytes):

@@ -78,6 +78,8 @@ KNOWN_SITES = frozenset((
     "serve.predict",
     "pool.drain",
     "pool.scale",
+    "pool.fork",
+    "store.budget",
     "stream.epoch",
 ))
 

@@ -180,6 +180,31 @@ _ALL = [
        "Memory backpressure release point: a paused host re-enters "
        "dispatch once its shm use drops below this fraction of its "
        "budget."),
+    # ---- data-gravity scheduling / AQE-fed store budgets --------------------
+    _k("RDT_LOCALITY_SPILLED_WEIGHT", "float", 0.5, PER_ACTION, "etl",
+       "Locality weight multiplier for bytes whose local copy is SPILLED "
+       "to disk: a spilled-local host scores between in-memory-local (1.0) "
+       "and remote (0) — reading spilled bytes pays a fault-in wherever "
+       "the task lands, so disk-local placement is a smaller win. 0 makes "
+       "spilled bytes count as absent; 1 restores tier-blind weighting."),
+    _k("RDT_STORE_STAGE_HINTS", "bool", True, PER_ACTION, "etl",
+       "Stage-aware eviction: each stage pins its input blobs in the "
+       "store for its duration and demotes them to evict-first when it "
+       "completes, so LRU only breaks ties among blobs no stage is "
+       "reading. 0 restores pure-LRU spill order."),
+    _k("RDT_STORE_AQE_BUDGET", "bool", True, PER_ACTION, "etl",
+       "Re-derive per-host store budgets from the AQE plane's measured "
+       "stage bytes (clamped to the statically configured capacity), so "
+       "cold bytes spill ahead of demand when the measured working set is "
+       "smaller than the static budget. 0 keeps static budgets only."),
+    _k("RDT_STORE_BUDGET_HEADROOM", "float", 1.5, PER_ACTION, "etl",
+       "Multiplier on the measured per-stage bytes when deriving store "
+       "budgets (derived = min(static capacity, measured x headroom))."),
+    _k("RDT_POOL_BYTES_PER_EXEC", "int", 0, PER_ACTION, "etl",
+       "Predictive autoscale: measured per-stage bytes each executor is "
+       "expected to carry; a grow decision targets ceil(measured stage "
+       "bytes / this) executors (capped by RDT_POOL_MAX). 0 disables the "
+       "byte-driven component (parked-demand sizing stays on)."),
     # ---- training / feed ----------------------------------------------------
     _k("RDT_PREFETCH_TO_DEVICE", "int", 2, PER_ACTION, "training",
        "Already-device_put batches the streaming feed keeps ahead of the "
@@ -362,6 +387,23 @@ _ALL = [
     _k("RDT_SUBMIT_ARGS", "str", None, PROCESS_START, "runtime",
        "JSON config packaged by rdt-submit; fills init() arguments left at "
        "their defaults.", internal=True),
+    # ---- warm-start executors -----------------------------------------------
+    _k("RDT_WARM_FORK", "bool", False, PER_ACTION, "runtime",
+       "Fork new workers from a pre-imported prototype process instead of "
+       "cold-spawning a fresh interpreter: scale-up readiness goes from "
+       "~seconds of jax/pyarrow import to process-fork-fast. Any warm-fork "
+       "failure degrades loudly to the cold-spawn path."),
+    _k("RDT_WARM_IMPORTS", "str", "pyarrow,pandas,numpy,cloudpickle,jax",
+       PROCESS_START, "runtime",
+       "Comma-separated modules the warm-fork prototype pre-imports; a "
+       "module that fails to import is skipped with a warning (the fork "
+       "still works, just colder)."),
+    _k("RDT_WARM_FORK_WAIT_S", "float", 15.0, PER_ACTION, "runtime",
+       "How long a spawn waits for the warm-fork prototype's readiness "
+       "handshake before falling back to cold spawn."),
+    _k("RDT_WARM_FORKED", "bool", False, PROCESS_START, "runtime",
+       "Set by the warm-fork plane in forked workers (telemetry reports "
+       "it as spawn provenance).", internal=True),
     # ---- fault plane --------------------------------------------------------
     _k("RDT_FAULTS", "str", None, PROCESS_START, "faults",
        "Declarative fault-injection spec (doc/fault_tolerance.md); loaded "

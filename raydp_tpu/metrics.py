@@ -131,6 +131,12 @@ _ALL_METRICS = [
        "Times dispatch to a host paused on the store high-watermark "
        "(memory backpressure trip transitions, not per-task skips).",
        label="host"),
+    _m("sched_locality_hits_total", COUNTER, "1", "scheduler",
+       "Task attempts dispatched to their locality-preferred executor "
+       "(data-gravity scheduling landed the task where its bytes are)."),
+    _m("pool_warm_forks_total", COUNTER, "1", "scheduler",
+       "Workers spawned by forking the pre-imported warm-start prototype "
+       "instead of cold-spawning a fresh interpreter."),
     _m("recovery_rounds_total", COUNTER, "1", "recovery",
        "Lineage-recovery rounds that re-executed producers."),
     _m("recovery_blobs_regenerated_total", COUNTER, "1", "recovery",
@@ -147,6 +153,9 @@ _ALL_METRICS = [
        label="op"),
     _m("store_objects_lost_total", COUNTER, "1", "store",
        "ObjectLostError raised: a blob was gone or unreachable at read."),
+    _m("store_fault_in_total", COUNTER, "1", "store",
+       "Spilled payloads faulted back into shared memory on read (the "
+       "disk-read side of the spill plane)."),
     # ---- tracing / telemetry plane ------------------------------------------
     _m("profiler_spans_dropped_total", COUNTER, "1", "profiler",
        "Trace spans silently evicted from the bounded per-process ring "
@@ -373,6 +382,16 @@ _ALL_EVENTS = [
     _e("pool_scale", "scheduler",
        "The autoscale controller grew or shrank the executor pool "
        "(direction + resulting size)."),
+    _e("warm_fork", "scheduler",
+       "A worker spawn went through (or degraded out of) the warm-fork "
+       "plane: forked pid, or the failure that fell back to cold spawn."),
+    _e("store_budget", "store",
+       "Per-host store budgets were re-derived from the AQE plane's "
+       "measured stage bytes (or the derivation degraded to the static "
+       "budgets on an injected store.budget fault)."),
+    _e("store_fault_in", "store",
+       "A spilled payload was faulted back into shared memory on read "
+       "(object id + host)."),
     _e("stage_abort", "scheduler",
        "A failing stage ran the abort contract (drain + free)."),
     _e("admission_reject", "scheduler",
@@ -576,6 +595,9 @@ def _collect_process_states(timeout: float = 10.0):
         from raydp_tpu.runtime.actor import ActorHandle
         for aid, rec in list(rt.records.items()):
             if rec.state != "ALIVE":
+                continue
+            if not rec.ready.is_set():
+                skipped += 1  # mid-restart: never park on the ready grace
                 continue
             role = rec.spec.name or aid
             try:

@@ -364,6 +364,12 @@ def collect_chrome_trace(path: Optional[str] = None,
             for aid, rec in list(rt.records.items()):
                 if rec.state != "ALIVE":
                     continue
+                if not rec.ready.is_set():
+                    # mid-restart: resolving would park on the 60 s
+                    # ready-waiter grace — telemetry skips NOW, counted
+                    skipped += 1
+                    pid += 1
+                    continue
                 role = rec.spec.name or aid
                 try:
                     handle = ActorHandle(aid, rec.spec.name,

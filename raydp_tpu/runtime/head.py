@@ -179,6 +179,15 @@ class HeadService:
     def store_locations(self, *a):
         return self._rt.store_server.locations(*a)
 
+    def store_residency(self, *a):
+        return self._rt.store_server.residency(*a)
+
+    def store_eviction_hints(self, *a):
+        return self._rt.store_server.eviction_hints(*a)
+
+    def store_derive_budgets(self, *a):
+        return self._rt.store_server.derive_budgets(*a)
+
     # pipelined-shuffle seal notifications: poll may return a DeferredReply
     # (the head's RPC server resolves it when events arrive or the poll
     # timeout lapses), so a long-polling reducer never parks a dispatcher
@@ -414,6 +423,9 @@ class RuntimeContext:
         #: attach-mode drivers: driver_id → last heartbeat monotonic time
         self._drivers: Dict[str, float] = {}  # guarded-by: _lock
         self.driver_reap_after_s = float(knobs.get("RDT_DRIVER_REAP_S"))
+        #: lazy warm-fork manager for the LOCAL spawn path (1-elem ref so the
+        #: shared spawn glue can create it on first use); agents own their own
+        self._warm_fork: List[Any] = [None]
         self._stopped = threading.Event()
 
         self.service = HeadService(self)
@@ -627,13 +639,24 @@ class RuntimeContext:
             env["PYTHONPATH"] = os.pathsep.join(driver_path)
             log_path = os.path.join(self.session_dir, "logs",
                                     f"{log_name}.out")
-            out = open(log_path, "ab")
-            rec.process = subprocess.Popen(
-                [sys.executable, "-m", "raydp_tpu.runtime.actor_main"],
-                env=env, stdout=out, stderr=subprocess.STDOUT,
-                start_new_session=True,
-            )
-            out.close()
+            proc = None
+            if bool(knobs.get("RDT_WARM_FORK")):
+                # fork-fast scale-up: clone the pre-imported prototype
+                # instead of paying a cold interpreter + import chain; any
+                # warm-plane failure falls through to the cold Popen below
+                from raydp_tpu.runtime import warm_fork
+                proc = warm_fork.warm_spawn(
+                    self._warm_fork, os.path.join(self.session_dir, "logs"),
+                    env, log_path, log_name)
+            if proc is None:
+                out = open(log_path, "ab")
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "raydp_tpu.runtime.actor_main"],
+                    env=env, stdout=out, stderr=subprocess.STDOUT,
+                    start_new_session=True,
+                )
+                out.close()
+            rec.process = proc
         rec.state = PENDING if rec.restart_count == 0 else RESTARTING
 
     def on_actor_ready(self, actor_id: str, address: tuple) -> None:
@@ -955,6 +978,13 @@ class RuntimeContext:
                 _terminate(rec.process)
             rec.state = DEAD
         self._resolve_waiters()  # every record is DEAD now: fail the waiters
+        if self._warm_fork[0] is not None:
+            # after the workers above are terminated: the prototype's death
+            # cascades (pdeathsig) to any forked worker still exiting
+            try:
+                self._warm_fork[0].stop()
+            except Exception:
+                pass
         self.store_client.close()
         # store shutdown BEFORE agent teardown: node-hosted payload releases
         # ride the still-open agent connections

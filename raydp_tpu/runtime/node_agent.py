@@ -196,6 +196,8 @@ class NodeAgent:
                                 max_concurrency=8, name="node-agent")
         self.procs: Dict[int, subprocess.Popen] = {}
         self._lock = threading.Lock()
+        #: lazy per-agent warm-fork manager (1-elem ref for the shared glue)
+        self._warm_fork: list = [None]
         self._stopped = threading.Event()
 
         store_isolated = bool(knobs.get("RDT_STORE_ISOLATED"))
@@ -287,13 +289,23 @@ class NodeAgent:
         paths.extend(p for p in sys.path if p)
         env["PYTHONPATH"] = os.pathsep.join(paths)
         log_path = os.path.join(self.log_dir, f"{log_name}.out")
-        out = open(log_path, "ab")
-        cmd = [sys.executable] + (list(argv) if argv
-                                  else ["-m", "raydp_tpu.runtime.actor_main"])
-        proc = subprocess.Popen(
-            cmd, env=env, stdout=out, stderr=subprocess.STDOUT,
-            start_new_session=True, preexec_fn=_die_with_parent)
-        out.close()
+        proc = None
+        if argv is None and bool(knobs.get("RDT_WARM_FORK")):
+            # fork-fast scale-up for the default actor bootstrap only (SPMD
+            # ranks and other entry points keep their exec semantics); any
+            # warm-plane failure falls through to the cold Popen below
+            from raydp_tpu.runtime import warm_fork
+            proc = warm_fork.warm_spawn(self._warm_fork, self.log_dir,
+                                        env, log_path, log_name)
+        if proc is None:
+            out = open(log_path, "ab")
+            cmd = [sys.executable] + (
+                list(argv) if argv
+                else ["-m", "raydp_tpu.runtime.actor_main"])
+            proc = subprocess.Popen(
+                cmd, env=env, stdout=out, stderr=subprocess.STDOUT,
+                start_new_session=True, preexec_fn=_die_with_parent)
+            out.close()
         with self._lock:
             self.procs[proc.pid] = proc
         logger.info("spawned actor process %d (%s)", proc.pid, log_name)
@@ -364,6 +376,11 @@ class NodeAgent:
                         proc.kill()
                     except ProcessLookupError:
                         pass
+        if self._warm_fork[0] is not None:
+            try:
+                self._warm_fork[0].stop()
+            except Exception:
+                pass
         self.server.stop()
         try:
             self.payload_host.shutdown()

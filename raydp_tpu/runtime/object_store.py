@@ -530,6 +530,18 @@ class ObjectStoreServer:
         self.shm_budget = shm_budget
         self._shm_bytes = 0        # unspilled head-host payload bytes
         self._spilled_bytes = 0
+        # stage-aware eviction hints (doc/etl.md "Store budgets"): the
+        # engine pins the blobs of the stage it is currently consuming
+        # (refcounted — concurrent stages can share inputs) and demotes
+        # them to evict-first once their consumer stage completes. The
+        # spill victim sort reads these as a priority band; LRU breaks
+        # ties only. Pinned blobs still spill as a LAST resort — the
+        # budget invariant outranks any hint.
+        self._pin_counts: Dict[str, int] = {}  # guarded-by: _lock
+        self._evict_first: set = set()         # guarded-by: _lock
+        # AQE-derived per-host budgets (derive_budgets): when set they
+        # tighten the statically configured capacity, never exceed it
+        self._derived_budgets: Dict[str, int] = {}  # guarded-by: _lock
         self._spill_locks: Dict[str, threading.Lock] = {}
         self._fault_gen = 0        # fault-in segments get fresh names (the
         #                            old name may still be alive under grace)
@@ -627,10 +639,19 @@ class ObjectStoreServer:
 
     def _budget_of(self, host_id: str) -> Optional[int]:
         if host_id == HEAD_HOST:
-            return self.shm_budget if self.spill_dir is not None else None
+            static = self.shm_budget if self.spill_dir is not None else None
+        else:
+            with self._lock:
+                static = self._host_budgets.get(host_id) \
+                    if self.node_spill is not None else None
+        if not static:
+            return None
+        # an AQE-derived budget only ever TIGHTENS the configured capacity
+        # (derive_budgets clamps it); absent a derivation the static
+        # ENV_STORE_* number stands
         with self._lock:
-            return self._host_budgets.get(host_id) \
-                if self.node_spill is not None else None
+            derived = self._derived_budgets.get(host_id)
+        return min(int(static), derived) if derived else static
 
     def _shm_used(self, host_id: str) -> int:  # guarded-by: _lock
         return self._shm_bytes if host_id == HEAD_HOST \
@@ -705,7 +726,14 @@ class ObjectStoreServer:
         never see recycled bytes. ``exclude`` (an id or a set of ids — a
         seal batch protects ALL its entries) exempts just-sealed objects
         from being the victim of their own seal. Parity: plasma
-        eviction/spill."""
+        eviction/spill.
+
+        Victim order is (hint band, LRU): evict-first blobs (their
+        consumer stage completed) go before unhinted ones, and blobs
+        pinned by a running stage go LAST — spilled only when nothing
+        else can satisfy the budget, because the budget invariant
+        outranks any hint (the out-of-core bench's bounded-shm
+        contract). LRU breaks ties within a band."""
         budget = self._budget_of(host_id)
         if not budget:
             return
@@ -716,12 +744,15 @@ class ObjectStoreServer:
                 if self._shm_used(host_id) <= budget:
                     return
                 victims = sorted(
-                    ((e.last_access, oid) for oid, e in self._table.items()
+                    ((0 if oid in self._evict_first
+                      else 2 if self._pin_counts.get(oid) else 1,
+                      e.last_access, oid)
+                     for oid, e in self._table.items()
                      if e.host_id == host_id and not e.spilled
                      and e.size > 0 and oid not in excluded))
                 if not victims:
                     return
-                victim = victims[0][1]
+                victim = victims[0][2]
             if not self._spill_one(host_id, victim):
                 return
 
@@ -838,6 +869,9 @@ class ObjectStoreServer:
                 self._spilled_bytes -= size
                 committed = True
         if committed:
+            metrics.inc("store_fault_in_total")
+            metrics.record_event("store_fault_in", object_id=object_id,
+                                 host=host_id)
             remove_spill(object_id)
         self._maybe_spill(host_id, exclude=object_id)
 
@@ -927,6 +961,98 @@ class ObjectStoreServer:
             return {oid: self._table[oid].host_id for oid in object_ids
                     if oid in self._table}
 
+    def residency(self, object_ids: List[str]
+                  ) -> Dict[str, Tuple[str, str]]:
+        """``object_id -> (host_id, tier)`` for the ids present; tier is
+        ``"shm"`` (payload resident in shared memory on that host) or
+        ``"spilled"`` (on that host's disk — a read pays a fault-in
+        first). The tier-blind view is :meth:`locations`; the engine's
+        data-gravity weighting reads this one, so a host holding only a
+        spilled copy scores between in-memory-local and remote
+        (doc/etl.md "Data-gravity scheduling")."""
+        self._count_op("residency")
+        with self._lock:
+            return {oid: (self._table[oid].host_id,
+                          "spilled" if self._table[oid].spilled else "shm")
+                    for oid in object_ids if oid in self._table}
+
+    def eviction_hints(self, pin: Optional[List[str]] = None,
+                       unpin: Optional[List[str]] = None,
+                       evict_first: Optional[List[str]] = None
+                       ) -> Dict[str, int]:
+        """Stage-aware eviction hints from the engine's stage ledger:
+        ``pin`` marks blobs a dispatching stage is about to consume
+        (refcounted — concurrent stages can share inputs), ``unpin``
+        releases one pin and, at refcount zero, demotes the blob to
+        evict-first (its consumer stage completed), ``evict_first``
+        demotes explicitly. Advisory only: :meth:`_maybe_spill` reads
+        the bands, the budget invariant always wins. Returns the live
+        band sizes."""
+        self._count_op("eviction_hints")
+        with self._lock:
+            for oid in pin or ():
+                self._pin_counts[oid] = self._pin_counts.get(oid, 0) + 1
+                self._evict_first.discard(oid)
+            for oid in unpin or ():
+                n = self._pin_counts.get(oid)
+                if n is None:
+                    continue
+                if n <= 1:
+                    del self._pin_counts[oid]
+                    self._evict_first.add(oid)
+                else:
+                    self._pin_counts[oid] = n - 1
+            for oid in evict_first or ():
+                if not self._pin_counts.get(oid):
+                    self._evict_first.add(oid)
+            return {"pinned": len(self._pin_counts),
+                    "evict_first": len(self._evict_first)}
+
+    def derive_budgets(self, measured_bytes: int) -> Dict[str, int]:
+        """Re-derive per-host shm budgets from the AQE plane's measured
+        per-stage bytes: derived = min(static capacity, measured x
+        RDT_STORE_BUDGET_HEADROOM), floored at 1 MiB. Derived budgets
+        only ever TIGHTEN the statically configured ``ENV_STORE_*``
+        capacity — when the measured working set is smaller, cold bytes
+        spill ahead of demand; a workload bigger than capacity keeps the
+        static number. Hosts without spill plumbing are untouched.
+
+        The ``store.budget`` chaos site fires here (key: the measured
+        byte count); an injected failure degrades LOUDLY to the static
+        budgets (derived state cleared) instead of erroring."""
+        self._count_op("derive_budgets")
+        measured = max(0, int(measured_bytes))
+        rule = faults.check("store.budget", key=str(measured))
+        if rule is not None:
+            try:
+                faults.apply(rule, "store.budget")
+            except Exception as exc:
+                logger.warning("store budget derivation failed (injected); "
+                               "keeping static budgets: %s", exc)
+                with self._lock:
+                    self._derived_budgets.clear()
+                metrics.record_event("store_budget",
+                                     measured_bytes=measured, degraded=True)
+                return {}
+        headroom = max(0.0, float(knobs.get("RDT_STORE_BUDGET_HEADROOM")))
+        target = max(1 << 20, int(measured * headroom))
+        derived: Dict[str, int] = {}
+        with self._lock:
+            if self.shm_budget and self.spill_dir is not None:
+                derived[HEAD_HOST] = min(int(self.shm_budget), target)
+            if self.node_spill is not None:
+                for host_id, cap in self._host_budgets.items():
+                    derived[host_id] = min(int(cap), target)
+            self._derived_budgets = dict(derived)
+        metrics.record_event("store_budget", measured_bytes=measured,
+                             headroom=headroom, hosts=len(derived),
+                             budget=(target if derived else 0))
+        # a tightened budget spills cold bytes ahead of demand, off the
+        # read/write hot paths
+        for host_id in derived:
+            self._maybe_spill(host_id)
+        return derived
+
     # -- pipelined-shuffle seal notifications ----------------------------------
     def stream_begin(self, stage_key: str, num_maps: int) -> None:
         """Open a seal stream for one shuffle stage (driver, before any
@@ -983,6 +1109,10 @@ class ObjectStoreServer:
         freed = []
         with self._lock:
             for oid in object_ids:
+                # eviction-hint state dies with the blob (a stale hint for
+                # a reused id would misprioritize the newcomer)
+                self._pin_counts.pop(oid, None)
+                self._evict_first.discard(oid)
                 e = self._table.pop(oid, None)
                 if e is not None:
                     freed.append((oid, e))
@@ -1049,6 +1179,8 @@ class ObjectStoreServer:
         freed = []
         with self._lock:
             for oid in [o for o, e in self._table.items() if e.owner == owner]:
+                self._pin_counts.pop(oid, None)
+                self._evict_first.discard(oid)
                 freed.append((oid, self._table.pop(oid)))
         self._release_payloads(freed)
         return len(freed)
@@ -1062,6 +1194,8 @@ class ObjectStoreServer:
                         if e.host_id == host_id]:
                 if self._table[oid].spilled:
                     self._spilled_bytes -= self._table[oid].size
+                self._pin_counts.pop(oid, None)
+                self._evict_first.discard(oid)
                 del self._table[oid]
                 dropped += 1
             self._host_bytes.pop(host_id, None)
@@ -1076,6 +1210,11 @@ class ObjectStoreServer:
             budgets: Dict[str, int] = dict(self._host_budgets)
             if self.shm_budget and self.spill_dir is not None:
                 budgets[HEAD_HOST] = int(self.shm_budget)
+            host_spilled: Dict[str, int] = {}
+            for e in self._table.values():
+                if e.spilled:
+                    host_spilled[e.host_id] = \
+                        host_spilled.get(e.host_id, 0) + e.size
             return {
                 "num_objects": len(self._table),
                 "total_bytes": sum(e.size for e in self._table.values()),
@@ -1091,6 +1230,14 @@ class ObjectStoreServer:
                 "host_shm": {HEAD_HOST: self._shm_bytes,
                              **dict(self._host_bytes)},
                 "host_budgets": budgets,
+                # residency-tier + policy-plane visibility (data-gravity
+                # scheduling / stage-aware eviction): per-host spilled
+                # bytes, live hint-band sizes, and any AQE-derived
+                # budgets currently tightening the static capacity
+                "host_spilled": host_spilled,
+                "pinned_objects": len(self._pin_counts),
+                "evict_first_objects": len(self._evict_first),
+                "derived_budgets": dict(self._derived_budgets),
             }
 
     def owned_by(self, owner: str) -> List[str]:
@@ -1842,6 +1989,32 @@ class ObjectStoreClient:
         """``object_id -> host_id`` (the machine holding each payload)."""
         self.meta_rpc_count += 1
         return self._server.locations([r.id for r in refs])
+
+    def residency(self, refs: List[ObjectRef]) -> Dict[str, Tuple[str, str]]:
+        """``object_id -> (host_id, tier)`` with tier ``"shm"`` or
+        ``"spilled"`` — the engine's data-gravity locality source (the
+        tier-blind twin is :meth:`locations`)."""
+        self.meta_rpc_count += 1
+        return self._server.residency([r.id for r in refs])
+
+    def eviction_hints(self, pin: Optional[List[ObjectRef]] = None,
+                       unpin: Optional[List[ObjectRef]] = None,
+                       evict_first: Optional[List[ObjectRef]] = None
+                       ) -> Dict[str, int]:
+        """Push stage-aware eviction hints (pin the stage being consumed,
+        evict-first what its consumers finished with). Policy-plane, not
+        metadata-plane: deliberately NOT counted in ``meta_rpc_count``,
+        so the benches' metadata-RPC comparisons measure the data plane
+        unchanged."""
+        return self._server.eviction_hints(
+            [r.id for r in pin or ()],
+            [r.id for r in unpin or ()],
+            [r.id for r in evict_first or ()])
+
+    def derive_budgets(self, measured_bytes: int) -> Dict[str, int]:
+        """Re-derive per-host store budgets from measured stage bytes
+        (policy-plane; uncounted like :meth:`eviction_hints`)."""
+        return self._server.derive_budgets(int(measured_bytes))
 
     def stats(self) -> Dict[str, Any]:
         return self._server.stats()

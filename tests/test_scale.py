@@ -913,3 +913,100 @@ def test_backpressure_fails_closed_on_stats_error(monkeypatch):
     assert len(a.submits) == 0, "stats failure fail-opened backpressure"
     assert pool.load()["backpressured_hosts"] == ["hostA"]
     assert calls["n"] >= 2
+
+
+# ==== predictive sizing + parked-demand cooldown pierce (ISSUE 19) ===========
+
+
+def _park(pool, n, tenant="t"):
+    with pool._lock:
+        pool._parked_by_tenant[tenant] = \
+            pool._parked_by_tenant.get(tenant, 0) + n
+    pool._demand_delta(n)
+
+
+def _unpark(pool, n, tenant="t"):
+    with pool._lock:
+        pool._parked_by_tenant[tenant] -= n
+        if pool._parked_by_tenant[tenant] <= 0:
+            del pool._parked_by_tenant[tenant]
+    pool._demand_delta(-n)
+
+
+def test_parked_demand_pierces_cooldown(monkeypatch):
+    """The post-shrink cooldown must not delay a grow when admission has
+    PARKED demand: parked actions cannot run until capacity exists, so the
+    hysteresis that guards against recovery spikes does not apply. One
+    prior tick of parked demand is required (no same-tick double-spawn)."""
+    monkeypatch.setenv("RDT_POOL_SCALE_UP_S", "30")   # window would block
+    monkeypatch.setenv("RDT_POOL_COOLDOWN_S", "60")   # cooldown would block
+    pool = ExecutorPool([StubExecutor(name="e0")])
+    sess = _FakeSession(pool)
+    auto = _autoscaler(sess, min_size=1, max_size=4)
+    auto._note("down", 1, "test")   # a fresh scale event arms the cooldown
+    _park(pool, 2)
+    auto._tick()                    # observes parked demand (arms window)
+    assert sess.grown == 0, "same-tick parked demand grew immediately"
+    auto._tick()                    # prior-tick parked demand: grow NOW
+    assert sess.grown == 2, "cooldown suppressed parked-demand grow"
+    assert auto.events[-1]["direction"] == "up"
+    assert "parked=2" in auto.events[-1]["reason"]
+    _unpark(pool, 2)
+
+
+def test_parked_demand_sizes_grow_predictively(monkeypatch):
+    """One grow decision targets one free slot per parked admission —
+    capped at the max bound — instead of stepping +1 per cooldown."""
+    monkeypatch.setenv("RDT_POOL_SCALE_UP_S", "0")
+    monkeypatch.setenv("RDT_POOL_COOLDOWN_S", "0")
+    pool = ExecutorPool([StubExecutor(name="e0")])
+    sess = _FakeSession(pool)
+    auto = _autoscaler(sess, min_size=1, max_size=3)
+    _park(pool, 5)
+    auto._tick()
+    auto._tick()
+    assert len(pool.executors) == 3, "parked grow did not reach the cap"
+    # the cap held: 5 parked would have wanted 6 executors
+    assert sess.grown == 2
+    _unpark(pool, 5)
+
+
+def test_aqe_measured_bytes_size_the_pool(monkeypatch):
+    """Predictive sizing from the AQE plane: with RDT_POOL_BYTES_PER_EXEC
+    set, a grow decision targets ceil(measured stage bytes / knob)
+    executors (a fake ledger supplies the measurement)."""
+    monkeypatch.setenv("RDT_POOL_SCALE_UP_S", "0")
+    monkeypatch.setenv("RDT_POOL_COOLDOWN_S", "0")
+    monkeypatch.setenv("RDT_POOL_BYTES_PER_EXEC", "100")
+    pool = ExecutorPool([StubExecutor(name="e0")])
+    sess = _FakeSession(pool)
+    sess.engine.measured_stage_bytes = lambda: 450   # -> ceil(4.5) = 5
+    auto = _autoscaler(sess, min_size=1, max_size=8)
+    pool._demand_delta(1)   # any queued demand triggers the decision
+    auto._tick()
+    assert len(pool.executors) == 5, \
+        f"AQE sizing off: {len(pool.executors)} executors"
+    assert "target=5" in auto.events[-1]["reason"]
+    pool._demand_delta(-1)
+    # without the knob the same decision steps +1
+    monkeypatch.setenv("RDT_POOL_BYTES_PER_EXEC", "0")
+    monkeypatch.setenv("RDT_POOL_COOLDOWN_S", "0")
+    auto._cooldown_until = 0.0
+    pool._demand_delta(1)
+    auto._tick()
+    assert len(pool.executors) == 6
+    pool._demand_delta(-1)
+
+
+def test_autoscaler_feeds_store_budget_derivation():
+    """Every tick forwards the stage ledger's measured bytes to the store
+    budget plane (Engine.derive_store_budgets) when the engine exposes it;
+    bare stubs without the method are tolerated."""
+    pool = ExecutorPool([StubExecutor(name="e0")])
+    sess = _FakeSession(pool)
+    calls = []
+    sess.engine.derive_store_budgets = lambda: calls.append(1)
+    auto = _autoscaler(sess, min_size=1, max_size=2)
+    auto._tick()
+    auto._tick()
+    assert len(calls) == 2, "budget feed not driven from the tick"

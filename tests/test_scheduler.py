@@ -375,3 +375,103 @@ def test_locality_reweights_streaming_reducers_from_seals_so_far(
     monkeypatch.setattr(E, "get_client", lambda: _Client2())
     assert engine._locality(
         [[E._StreamBucket(rec, 0), (big_b, 0, 9000)]]) == ["eB"]
+
+
+# ==== data-gravity scheduling: residency tiers (ISSUE 19) ====================
+
+
+def test_locality_tier_matrix(monkeypatch):
+    """The data-gravity weight order: shm > spilled > remote > absent. A
+    host whose copy is SPILLED counts its bytes at
+    RDT_LOCALITY_SPILLED_WEIGHT (default 0.5) — between in-memory-local
+    and remote — so a fault-in storm can lose to a bigger shm pile, a
+    spilled-local copy still beats no copy, and weight 0 disqualifies
+    spilled copies entirely."""
+    pool = ExecutorPool([StubExecutor(name="eA"), StubExecutor(name="eB")],
+                        hosts_by_name={"eA": "hostA", "eB": "hostB"})
+    engine = E.Engine(pool)
+    ra = ObjectRef(id="a" * 32, size=1000)   # shm copy on hostA
+    rb = ObjectRef(id="b" * 32, size=1600)   # spilled copy on hostB
+
+    class _Client:
+        def residency(self, refs):
+            return {("a" * 32): ("hostA", "shm"),
+                    ("b" * 32): ("hostB", "spilled"),
+                    ("c" * 32): ("hostB", "spilled")}
+
+    monkeypatch.setattr(E, "get_client", lambda: _Client())
+    # hostB holds MORE raw bytes (1600 > 1000), but spilled at 0.5 weighs
+    # 800: the smaller shm pile wins
+    assert engine._locality([[ra, rb]]) == ["eA"]
+    # enough spilled bytes still win: 0.5 x 2400 = 1200 > 1000
+    rc = ObjectRef(id="c" * 32, size=2400)
+    assert engine._locality([[ra, rc]]) == ["eB"]
+    # spilled-local beats remote/absent: the only copy is hostB's disk
+    assert engine._locality([[rb]]) == ["eB"]
+    # absent bytes weigh nothing: no residency entry, no preference
+    rz = ObjectRef(id="f" * 32, size=9999)
+    assert engine._locality([[rz]]) == [None]
+    # weight 0 makes a spilled copy indistinguishable from absent
+    monkeypatch.setenv("RDT_LOCALITY_SPILLED_WEIGHT", "0")
+    assert engine._locality([[rb]]) == [None]
+
+
+def test_locality_tier_tie_rotation(monkeypatch):
+    """Hosts tied on weight rotate deterministically across picks instead
+    of always landing on the first-sorted host — tied placements spread."""
+    pool = ExecutorPool([StubExecutor(name="eA"), StubExecutor(name="eB")],
+                        hosts_by_name={"eA": "hostA", "eB": "hostB"})
+    engine = E.Engine(pool)
+    ra = ObjectRef(id="a" * 32, size=1000)
+    rb = ObjectRef(id="b" * 32, size=1000)
+
+    class _Client:
+        def residency(self, refs):
+            return {("a" * 32): ("hostA", "shm"),
+                    ("b" * 32): ("hostB", "shm")}
+
+    monkeypatch.setattr(E, "get_client", lambda: _Client())
+    # each task reads 1000 bytes from BOTH hosts: a dead tie, rotated
+    tied_task = [ra, rb]
+    assert engine._locality([tied_task, tied_task, tied_task, tied_task]) \
+        == ["eA", "eB", "eA", "eB"]
+
+
+def test_pick_weighted_skips_draining_host():
+    """The heaviest host that still has a DISPATCHABLE member wins: when
+    the shm-local host is draining, the runner-up (e.g. the machine with
+    the spilled copy) takes the task instead of an arbitrary executor."""
+    pool = ExecutorPool([StubExecutor(name="eA"), StubExecutor(name="eB")],
+                        hosts_by_name={"eA": "hostA", "eB": "hostB"})
+    assert pool.pick_weighted({"hostA": 10.0, "hostB": 1.0}) == "eA"
+    assert pool.begin_drain("eA")
+    assert pool.pick_weighted({"hostA": 10.0, "hostB": 1.0}) == "eB"
+    # nothing dispatchable at any weighted host: no preference
+    assert pool.pick_weighted({"hostZ": 5.0}) is None
+    assert pool.pick_weighted({}) is None
+
+
+def test_locality_stream_bucket_sees_tiers(monkeypatch):
+    """A streaming reducer's seal-driven ranges weight by residency tier
+    too: a big spilled seal can lose to a smaller shm seal elsewhere."""
+    pool = ExecutorPool([StubExecutor(name="eA"), StubExecutor(name="eB")],
+                        hosts_by_name={"eA": "hostA", "eB": "hostB"})
+    engine = E.Engine(pool)
+    ra = ObjectRef(id="a" * 32, size=5000)   # spilled on hostA
+    rb = ObjectRef(id="b" * 32, size=4000)   # shm on hostB
+
+    class _Client:
+        def residency(self, refs):
+            return {("a" * 32): ("hostA", "spilled"),
+                    ("b" * 32): ("hostB", "shm")}
+
+    monkeypatch.setattr(E, "get_client", lambda: _Client())
+    rec = E._StreamStageRec("ss-tier", "repartition", num_maps=2)
+    rec.seals[0] = (ra, [(0, 5000, 10)])
+    rec.seals[1] = (rb, [(0, 4000, 8)])
+    # bucket 0 reads 5000 spilled (-> 2500) + 4000 shm: hostB wins even
+    # though hostA holds more raw bytes
+    assert engine._locality([[E._StreamBucket(rec, 0)]]) == ["eB"]
+    # at full spilled weight the raw byte count would win instead
+    monkeypatch.setenv("RDT_LOCALITY_SPILLED_WEIGHT", "1.0")
+    assert engine._locality([[E._StreamBucket(rec, 0)]]) == ["eA"]

@@ -106,3 +106,99 @@ def test_spill_disabled_with_zero_budget():
             assert client.get(ref) == np.random.RandomState(i).bytes(OBJ)
     finally:
         rt.shutdown()
+
+
+# ==== stage-aware eviction + AQE-fed budgets (ISSUE 19) ======================
+
+
+def test_eviction_hints_order_bands(spill_rt):
+    """Victim order is (hint band, LRU): evict-first blobs (consumer stage
+    done) spill before unhinted ones; blobs pinned by a running stage go
+    last; LRU breaks ties only inside a band."""
+    rt = spill_rt
+    client = rt.store_client
+    server = rt.store_server
+    refs = [client.put_raw(np.random.RandomState(i).bytes(OBJ))
+            for i in range(5)]  # 2.0 MB of the 2 MiB budget: nothing spills
+    client.eviction_hints(pin=[refs[0], refs[1]], evict_first=[refs[4]])
+    # overflow by two objects: exactly two victims must spill
+    client.put_raw(np.random.RandomState(100).bytes(OBJ))
+    client.put_raw(np.random.RandomState(101).bytes(OBJ))
+    with server._lock:
+        spilled = {oid for oid, e in server._table.items() if e.spilled}
+    assert refs[4].id in spilled, "evict-first blob outlived the overflow"
+    assert refs[0].id not in spilled and refs[1].id not in spilled, \
+        "a pinned blob spilled while unpinned candidates remained"
+    # the second victim is the LRU of the unhinted band (refs[2] < refs[3])
+    assert refs[2].id in spilled and refs[3].id not in spilled
+    # unpin at refcount zero demotes to evict-first: the released blobs
+    # become the next victims, ahead of the (newer) unhinted overflow blobs
+    client.eviction_hints(unpin=[refs[0], refs[1]])
+    client.put_raw(np.random.RandomState(102).bytes(OBJ))
+    with server._lock:
+        spilled2 = {oid for oid, e in server._table.items() if e.spilled}
+    assert refs[0].id in spilled2, "released pin was not evicted first"
+    assert refs[3].id not in spilled2
+
+
+def test_pin_refcounts_shared_inputs(spill_rt):
+    """Two concurrent stages pinning the same blob: one stage finishing
+    (one unpin) must NOT demote it while the other still reads it."""
+    rt = spill_rt
+    client = rt.store_client
+    server = rt.store_server
+    ref = client.put_raw(b"x" * 1000)
+    client.eviction_hints(pin=[ref])
+    client.eviction_hints(pin=[ref])        # second stage shares the input
+    client.eviction_hints(unpin=[ref])      # first stage completes
+    stats = server.stats()
+    assert stats["pinned_objects"] == 1, "shared pin dropped too early"
+    assert stats["evict_first_objects"] == 0
+    client.eviction_hints(unpin=[ref])      # second stage completes
+    stats = server.stats()
+    assert stats["pinned_objects"] == 0
+    assert stats["evict_first_objects"] == 1
+
+
+def test_pinned_blobs_still_spill_as_last_resort(spill_rt):
+    """The budget invariant outranks every hint: with ALL blobs pinned, an
+    overflow still spills (pinned band last) and shm stays bounded."""
+    rt = spill_rt
+    client = rt.store_client
+    refs = [client.put_raw(np.random.RandomState(i).bytes(OBJ))
+            for i in range(5)]
+    client.eviction_hints(pin=refs)
+    for i in range(4):
+        client.put_raw(np.random.RandomState(200 + i).bytes(OBJ))
+    stats = rt.store_server.stats()
+    assert stats["shm_bytes"] <= BUDGET + OBJ, \
+        "pinning broke the bounded-shm contract"
+    assert stats["spilled_objects"] > 0
+    # everything still reads back (transparent fault-in)
+    for i, ref in enumerate(refs):
+        assert client.get(ref) == np.random.RandomState(i).bytes(OBJ)
+
+
+def test_derive_budgets_tightens_never_widens(spill_rt):
+    """AQE-fed budgets: derived = min(static, measured x headroom). A small
+    measured working set tightens the budget (cold bytes spill ahead of
+    demand); a huge one leaves the static capacity standing."""
+    rt = spill_rt
+    client = rt.store_client
+    server = rt.store_server
+    for i in range(4):  # 1.6 MB: under the 2 MiB static budget, all shm
+        client.put_raw(np.random.RandomState(i).bytes(OBJ))
+    assert server.stats()["spilled_objects"] == 0
+    # measured 400 KB x 1.5 headroom = 600 KB -> floored to 1 MiB: spills
+    # the cold tail down to the derived budget
+    derived = client.derive_budgets(400_000)
+    from raydp_tpu.runtime.object_store import HEAD_HOST
+    assert derived[HEAD_HOST] == 1 << 20
+    stats = server.stats()
+    assert stats["derived_budgets"] == {HEAD_HOST: 1 << 20}
+    assert stats["shm_bytes"] <= (1 << 20), \
+        "tightened budget did not spill ahead of demand"
+    assert stats["spilled_objects"] >= 2
+    # a measured set far past capacity: the static number stands
+    derived = client.derive_budgets(100 << 20)
+    assert derived[HEAD_HOST] == BUDGET

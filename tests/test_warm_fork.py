@@ -1,0 +1,132 @@
+"""Warm-start fork plane: prototype lifecycle, pre-readiness death reaping,
+chaos at the ``pool.fork`` site, and loud degrade-to-cold fallback."""
+
+import os
+import socket
+
+import pytest
+
+from raydp_tpu import faults, metrics
+from raydp_tpu.runtime import warm_fork
+from raydp_tpu.runtime.head import ENV_ACTOR_ID, ENV_HEAD, ENV_SESSION
+
+
+@pytest.fixture
+def fast_prototype(monkeypatch):
+    """No heavy pre-imports: the prototype handshake is near-instant."""
+    monkeypatch.setenv("RDT_WARM_IMPORTS", "")
+    monkeypatch.setenv("RDT_WARM_FORK_WAIT_S", "10")
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def _bootstrap_env(head_url="127.0.0.1:1"):
+    """An env whose actor bootstrap dies fast (unreachable head)."""
+    return {ENV_HEAD: head_url, ENV_ACTOR_ID: "a-test",
+            ENV_SESSION: "s-test", "PYTHONPATH": os.getcwd()}
+
+
+def test_pre_readiness_death_is_reaped(fast_prototype, tmp_path):
+    """A forked worker that dies before its readiness handshake must be
+    reported dead through poll (no phantom ALIVE), reaped by the prototype
+    (no zombie), and must NOT latch the plane — worker death is a worker
+    problem, not a warm-plane problem."""
+    mgr = warm_fork.WarmForkManager(str(tmp_path))
+    try:
+        child = mgr.fork({}, str(tmp_path / "w0.log"), key="w0")
+        rc = child.wait(timeout=15.0)
+        assert rc == 1, f"bootstrap-with-no-env should exit 1, got {rc}"
+        assert not os.path.exists(f"/proc/{child.pid}"), \
+            "prototype left the dead fork as a zombie"
+        assert mgr.available, "one worker death latched the whole plane"
+        c2 = mgr.fork({}, str(tmp_path / "w1.log"), key="w1")
+        assert c2.wait(timeout=15.0) == 1
+        kinds = [e for e in metrics.events() if e["kind"] == "warm_fork"]
+        assert len(kinds) == 2 and not any(e.get("degraded") for e in kinds)
+    finally:
+        mgr.stop()
+
+
+def test_forked_child_kill_contract(fast_prototype, tmp_path):
+    """ForkedChild honors the Popen surfaces the supervisor relies on:
+    poll() is None while alive, kill() lands (the child setsid()s so the
+    group kill works), and the signal death is reported as -SIGKILL."""
+    # a head that accepts but never answers keeps the bootstrap alive
+    trap = socket.socket()
+    trap.bind(("127.0.0.1", 0))
+    trap.listen(1)
+    mgr = warm_fork.WarmForkManager(str(tmp_path))
+    try:
+        env = _bootstrap_env("127.0.0.1:%d" % trap.getsockname()[1])
+        child = mgr.fork(env, str(tmp_path / "w0.log"), key="w0")
+        assert child.poll() is None, "live fork reported dead"
+        child.kill()
+        assert child.wait(timeout=15.0) == -9
+    finally:
+        mgr.stop()
+        trap.close()
+
+
+def test_pool_fork_crash_fault_kills_fresh_fork(fast_prototype, tmp_path):
+    """Chaos at ``pool.fork`` with the ``crash`` action kills the fork
+    after it exists but before readiness — the flight recorder marks the
+    injected death and the plane stays available for the retry."""
+    faults.clear()
+    faults.inject("pool.fork", "crash", times=1)
+    mgr = warm_fork.WarmForkManager(str(tmp_path))
+    try:
+        child = mgr.fork(_bootstrap_env(), str(tmp_path / "w0.log"),
+                         key="victim")
+        assert child.wait(timeout=15.0) not in (None, 0)
+        assert mgr.available
+        evs = [e for e in metrics.events() if e["kind"] == "warm_fork"]
+        assert any(e.get("injected_death") for e in evs)
+        # the rule was times=1: the next fork is clean
+        c2 = mgr.fork({}, str(tmp_path / "w1.log"), key="w1")
+        assert c2.wait(timeout=15.0) == 1
+        assert not [e for e in metrics.events()
+                    if e["kind"] == "warm_fork" and e.get("key") == "w1"
+                    and e.get("injected_death")]
+    finally:
+        faults.clear()
+        mgr.stop()
+
+
+def test_broken_prototype_degrades_loudly(fast_prototype, monkeypatch,
+                                          tmp_path):
+    """A prototype that cannot start degrades to cold spawn: warm_spawn
+    returns None (never raises), records a degraded ``warm_fork`` event,
+    and latches the manager so later spawns skip the broken plane."""
+    monkeypatch.setattr(warm_fork.sys, "executable", "/bin/false")
+    monkeypatch.setenv("RDT_WARM_FORK_WAIT_S", "2")
+    ref = [None]
+    proc = warm_fork.warm_spawn(ref, str(tmp_path), {},
+                                str(tmp_path / "w0.log"), "w0")
+    assert proc is None, "broken plane must cue the cold-spawn fallback"
+    assert ref[0] is not None and not ref[0].available, \
+        "first failure must latch the manager"
+    evs = [e for e in metrics.events() if e["kind"] == "warm_fork"]
+    assert any(e.get("degraded") and e.get("error") for e in evs)
+    # latched: the second attempt short-circuits without touching /bin/false
+    assert warm_fork.warm_spawn(ref, str(tmp_path), {},
+                                str(tmp_path / "w1.log"), "w1") is None
+    ref[0].stop()
+
+
+def test_fork_raise_fault_degrades_to_cold(fast_prototype, tmp_path):
+    """The ``raise`` action at ``pool.fork`` models a transient fork-path
+    fault: warm_spawn degrades to None and the caller cold-spawns, without
+    latching the plane (the injected raise fires before the protocol)."""
+    faults.clear()
+    faults.inject("pool.fork", "raise", times=1)
+    ref = [None]
+    try:
+        assert warm_fork.warm_spawn(ref, str(tmp_path), {},
+                                    str(tmp_path / "w.log"), "w0") is None
+        evs = [e for e in metrics.events() if e["kind"] == "warm_fork"]
+        assert any(e.get("degraded") for e in evs)
+    finally:
+        faults.clear()
+        if ref[0] is not None:
+            ref[0].stop()
