@@ -2766,8 +2766,17 @@ class Engine:
         sits in shared memory count at full weight; bytes whose copy is
         SPILLED to disk at ``RDT_LOCALITY_SPILLED_WEIGHT`` (the fault-in
         is paid wherever the task lands, so disk-local placement is a
-        smaller win than shm-local but still beats remote); absent bytes
-        weigh nothing. One bulk ``residency`` RPC (``locations`` when the
+        smaller win than shm-local but still beats remote); bytes a host
+        would PULL over the network count at
+        ``RDT_LOCALITY_REMOTE_WEIGHT`` — that crediting is
+        ranking-neutral among byte-holders (each host's score is
+        ``(1-r)*local + r*total``, monotone in its local bytes) but
+        gives every live host a real score, so when the gravity host is
+        draining or backpressured :meth:`ExecutorPool.pick_weighted`
+        falls back to a ranked live host instead of returning no
+        preference; 0 restores holder-only scoring, 1 is distance-blind
+        (all hosts tie and rotate). Absent bytes weigh nothing. One bulk
+        ``residency`` RPC (``locations`` when the
         store predates tiers — weighting then degrades to tier-blind); a
         no-op on single-machine pools so round-robin balance is
         untouched. The heaviest host that still has a dispatchable member
@@ -2823,9 +2832,14 @@ class Engine:
             return [None] * len(ref_lists)
         spilled_w = max(0.0,
                         float(knobs.get("RDT_LOCALITY_SPILLED_WEIGHT")))
+        remote_w = min(1.0, max(0.0, float(
+            knobs.get("RDT_LOCALITY_REMOTE_WEIGHT"))))
+        pool_hosts = (set(self.pool.hosts_by_name.values())
+                      if remote_w > 0 else set())
         preferred: List[Optional[str]] = []
         for refs in ref_lists:
             weight: Dict[str, float] = {}
+            total = 0.0
             for item in _flat(refs):
                 r, w = _norm(item)
                 loc = locs.get(r.id) if r is not None else None
@@ -2838,6 +2852,15 @@ class Engine:
                 scaled = w * (spilled_w if tier == "spilled" else 1.0)
                 if scaled > 0:
                     weight[host] = weight.get(host, 0.0) + scaled
+                    total += scaled
+            if remote_w > 0 and total > 0:
+                # local bytes at full (tier-scaled) weight, the rest of the
+                # task's bytes at the remote-pull discount: (1-r)*local +
+                # r*total — holder ranking is preserved, non-holders gain a
+                # ranked fallback score
+                weight = {h: (1.0 - remote_w) * weight.get(h, 0.0)
+                          + remote_w * total
+                          for h in pool_hosts | set(weight)}
             preferred.append(self.pool.pick_weighted(weight))
         return preferred
 

@@ -187,6 +187,14 @@ _ALL = [
        "and remote (0) — reading spilled bytes pays a fault-in wherever "
        "the task lands, so disk-local placement is a smaller win. 0 makes "
        "spilled bytes count as absent; 1 restores tier-blind weighting."),
+    _k("RDT_LOCALITY_REMOTE_WEIGHT", "float", 0.25, PER_ACTION, "etl",
+       "Locality weight multiplier for a task's bytes held on OTHER "
+       "dispatchable hosts (remote in-memory residency tier): every live "
+       "host is credited remote bytes x this, so when the byte-holding "
+       "host is draining or backpressured the ranking still prefers a "
+       "real host instead of returning no preference. 0 restores the "
+       "holder-only ranking; 1 scores remote copies like local ones "
+       "(distance-blind)."),
     _k("RDT_STORE_STAGE_HINTS", "bool", True, PER_ACTION, "etl",
        "Stage-aware eviction: each stage pins its input blobs in the "
        "store for its duration and demotes them to evict-first when it "
@@ -225,9 +233,10 @@ _ALL = [
        "leaves no param_rules entry matches; 0 restores the legacy "
        "largest-divisible-dim fsdp fallback."),
     _k("RDT_TRAIN_PAD_TAIL", "bool", True, PER_ACTION, "training",
-       "Pad-and-mask the ragged final batch under a >1 data extent: zero "
-       "rows square the batch and a mask drops them from losses/metrics. "
-       "0 restores the silent tail drop."),
+       "Pad-and-mask the ragged final batch under a >1 data extent (or a "
+       ">1 stage extent — the pipelined forward reshapes every batch into "
+       "microbatches): zero rows square the batch and a mask drops them "
+       "from losses/metrics. 0 restores the silent tail drop."),
     _k("RDT_TRAIN_ACCUM_STEPS", "int", 1, PER_ACTION, "training",
        "Gradient-accumulation microbatches per optimizer step: each global "
        "batch splits into this many slices scanned through the forward/"
@@ -236,9 +245,13 @@ _ALL = [
        "argument overrides."),
     _k("RDT_TRAIN_REMAT", "str", "none", PER_ACTION, "training",
        "Rematerialization policy for the train-step forward (jax.checkpoint "
-       "placement by role, parallel/roles.py): 'dots' keeps MXU products "
-       "(kernel/embedding contractions) and recomputes elementwise glue; "
-       "'full' recomputes everything; 'none' saves all residuals."),
+       "placement by role, parallel/roles.py): a global mode — 'dots' keeps "
+       "MXU products (kernel/embedding contractions) and recomputes "
+       "elementwise glue; 'full' recomputes everything; 'none' saves all "
+       "residuals — or a per-role 'role=mode,...' map over the param roles "
+       "('embedding=none,kernel=dots,default=full'), chosen per segment by "
+       "its dominant parameter role; a bare mode is the default policy for "
+       "every role. Validated eagerly, before any compile."),
     # ---- serving plane ------------------------------------------------------
     _k("RDT_SERVE_MAX_BATCH", "int", 64, PER_ACTION, "serving",
        "Micro-batch row cap: concurrent predict() requests coalesce into "
@@ -401,6 +414,16 @@ _ALL = [
     _k("RDT_WARM_FORK_WAIT_S", "float", 15.0, PER_ACTION, "runtime",
        "How long a spawn waits for the warm-fork prototype's readiness "
        "handshake before falling back to cold spawn."),
+    _k("RDT_WARM_FORK_RETRIES", "int", 2, PER_ACTION, "runtime",
+       "Supervised prototype restarts after a warm-fork plane failure: a "
+       "latched-failed plane re-warms a fresh prototype on the next fork "
+       "request, up to this many times per manager (0 keeps the "
+       "latch-permanent pre-r20 behavior). Each re-warm emits a warm_fork "
+       "re-warm event and counts pool_warm_refreshes_total."),
+    _k("RDT_WARM_REFRESH_COOLDOWN_S", "float", 30.0, PER_ACTION, "runtime",
+       "Minimum seconds between warm-fork prototype restarts: fork "
+       "requests inside the cooldown go straight to cold spawn instead of "
+       "hammering a crashing prototype."),
     _k("RDT_WARM_FORKED", "bool", False, PROCESS_START, "runtime",
        "Set by the warm-fork plane in forked workers (telemetry reports "
        "it as spawn provenance).", internal=True),

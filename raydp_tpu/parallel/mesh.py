@@ -132,6 +132,15 @@ def seq_extent(mesh) -> int:
     return int(mesh.shape.get("seq", 1)) if "seq" in mesh.axis_names else 1
 
 
+def stage_extent(mesh) -> int:
+    """Size of the mesh's ``stage`` axis (1 when absent) — the gate the
+    estimator checks before routing training through the GPipe schedule.
+    ``stage`` stays the OUTERMOST mesh axis (:data:`AXES`): its per-tick
+    boundary hops are the rarest collective, so they ride the slowest links
+    (cross-slice DCN on multi-slice deployments)."""
+    return int(mesh.shape.get("stage", 1)) if "stage" in mesh.axis_names else 1
+
+
 def replicated(mesh):
     from jax.sharding import NamedSharding, PartitionSpec
     return NamedSharding(mesh, PartitionSpec())

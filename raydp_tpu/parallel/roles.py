@@ -30,12 +30,21 @@ alone yields a fully sharded train state.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 #: path substrings that mark an embedding table (lowercased match). "embed"
 #: catches flax ``nn.Embed`` scopes and the conventional ``embedding`` /
 #: ``embed_tokens`` / ``token_embedder`` spellings in one token.
 EMBEDDING_TOKENS = ("embed",)
+
+#: path substrings that mark a stage-stacked leaf — per-layer parameter
+#: pytrees stacked on a leading axis by
+#: :func:`raydp_tpu.parallel.pipeline.stack_stage_params`. The leading dim is
+#: the layer stack and shards over the mesh's ``stage`` axis; the REST of the
+#: shape classifies through the ordinary role policy (the token is stripped
+#: before inner classification so a stacked kernel still gets tensor/fsdp on
+#: its inner dims).
+STAGE_TOKENS = ("stage_stack",)
 
 REPLICATED = "replicated"
 EMBEDDING = "embedding"
@@ -64,8 +73,26 @@ def _divides(dim: int, size: int) -> bool:
 
 def role_partition_spec(mesh, path: str, shape: Tuple[int, ...]):
     """The PartitionSpec the leaf's role wants on ``mesh`` (total: degrades
-    to replicated whenever an axis is absent, size 1, or does not divide)."""
+    to replicated whenever an axis is absent, size 1, or does not divide).
+
+    Stage-stacked leaves (path contains a :data:`STAGE_TOKENS` token) put the
+    mesh's ``stage`` axis on their leading (layer-stack) dim when it divides,
+    then classify the INNER shape through the ordinary role policy — a
+    stacked kernel is still a kernel on dims 1..n. Optimizer-state mirrors
+    (adam ``mu``/``nu``) inherit this for free: their paths carry the same
+    token."""
     from jax.sharding import PartitionSpec
+
+    low = path.lower()
+    if any(tok in low for tok in STAGE_TOKENS) and len(shape) >= 1:
+        stage = int(mesh.shape.get("stage", 1))
+        lead = shape[0]
+        head = "stage" if _divides(lead, stage) else None
+        inner_path = low
+        for tok in STAGE_TOKENS:
+            inner_path = inner_path.replace(tok, "")
+        inner = role_partition_spec(mesh, inner_path, tuple(shape[1:]))
+        return PartitionSpec(head, *inner)
 
     fsdp = int(mesh.shape.get("fsdp", 1))
     tensor = int(mesh.shape.get("tensor", 1))
@@ -140,6 +167,87 @@ def apply_remat(fn, mode: str):
     if policy is None:
         return fn
     return jax.checkpoint(fn, policy=policy)
+
+
+#: the roles a remat policy may key on: the param-role vocabulary plus
+#: ``default`` (the fallback mode — a bare mode string is sugar for
+#: ``default=<mode>``, which keeps the pre-r20 global knob meaning).
+REMAT_ROLES = (REPLICATED, EMBEDDING, KERNEL, "default")
+
+
+def parse_remat_policy(spec: str) -> Dict[str, str]:
+    """``RDT_TRAIN_REMAT`` / ``remat=`` grammar → a total role→mode map.
+
+    Accepts either a bare mode (``"dots"`` — the pre-r20 global form, now
+    meaning *default policy for every role*) or a comma-separated
+    ``role=mode`` list (``"embedding=none,kernel=dots,default=full"``).
+    Roles come from :data:`REMAT_ROLES`, modes from :data:`REMAT_MODES`;
+    anything else raises ``ValueError`` — validated eagerly, long before any
+    compile. The returned dict always carries a ``default`` entry
+    (``none`` unless the spec set one)."""
+    spec = (spec or "none").strip()
+    policy: Dict[str, str] = {}
+    if "=" not in spec:
+        if spec not in REMAT_MODES:
+            raise ValueError(
+                f"unknown remat mode {spec!r}: expected one of {REMAT_MODES} "
+                f"or a 'role=mode,...' policy over roles {REMAT_ROLES}")
+        policy["default"] = spec
+        return policy
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad remat policy entry {part!r} in {spec!r}: expected "
+                f"role=mode")
+        role, _, mode = (p.strip() for p in part.partition("="))
+        if role not in REMAT_ROLES:
+            raise ValueError(
+                f"unknown remat role {role!r} in {spec!r}: expected one of "
+                f"{REMAT_ROLES}")
+        if mode not in REMAT_MODES:
+            raise ValueError(
+                f"unknown remat mode {mode!r} for role {role!r} in {spec!r}: "
+                f"expected one of {REMAT_MODES}")
+        if role in policy:
+            raise ValueError(f"duplicate remat role {role!r} in {spec!r}")
+        policy[role] = mode
+    policy.setdefault("default", "none")
+    return policy
+
+
+def remat_mode_for_role(policy: Dict[str, str], role: str) -> str:
+    """The mode a parsed policy assigns to one param role (``default``
+    fallback — the policy map is total by construction)."""
+    return policy.get(role, policy["default"])
+
+
+def segment_role(tree) -> str:
+    """The dominant param role of a (sub)tree, weighted by leaf bytes — the
+    role whose parameters own most of the segment's memory decides which
+    remat mode the segment's forward runs under, exactly how the param specs
+    are chosen leaf-by-leaf. Empty trees classify ``replicated``."""
+    import jax
+
+    weights: Dict[str, int] = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        path_str = "/".join(
+            str(getattr(p, "key", getattr(p, "name", p))) for p in path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            size = 1
+            for d in shape:
+                size *= int(d)
+            nbytes = size * 4
+        role = classify_param(path_str, shape)
+        weights[role] = weights.get(role, 0) + int(nbytes)
+    if not weights:
+        return REPLICATED
+    return max(weights.items(), key=lambda kv: (kv[1], kv[0]))[0]
 
 
 def describe_roles(tree) -> dict:

@@ -24,8 +24,11 @@ Topology and failure containment:
 - Any warm-plane failure (prototype won't start, handshake timeout, protocol
   error) raises :class:`WarmForkError`; callers degrade LOUDLY to the cold
   spawn path (a warning plus a degraded ``warm_fork`` event) and the manager
-  refuses further forks. Warm start is an accelerator, never a correctness
-  dependency.
+  latches failed. The latch is supervised, not permanent: after
+  ``RDT_WARM_REFRESH_COOLDOWN_S`` the next fork request re-warms a fresh
+  prototype (bounded by ``RDT_WARM_FORK_RETRIES`` restarts, each counted by
+  ``pool_warm_refreshes_total``), so long sessions stay fork-fast. Warm
+  start is an accelerator, never a correctness dependency.
 - A forked child that dies before its readiness handshake is reaped by the
   prototype's ``waitpid`` loop (no zombie) and reported dead through
   :meth:`WarmForkManager.poll_child` (no phantom ALIVE worker).
@@ -271,9 +274,15 @@ class ForkedChild:
 class WarmForkManager:
     """Owns one prototype process and serves fork-fast spawns from it.
 
-    Failure latch: the first start/protocol failure marks the manager failed
-    — every later :meth:`fork` raises immediately and the caller cold-spawns.
-    A flapping prototype must not turn scale-up into a retry storm."""
+    Failure latch + supervised refresh: a start/protocol failure marks the
+    manager failed — forks inside the latch raise immediately and the caller
+    cold-spawns (a flapping prototype must not turn scale-up into a retry
+    storm). But the latch is no longer permanent: once
+    ``RDT_WARM_REFRESH_COOLDOWN_S`` has passed, the next fork request
+    re-warms a fresh prototype (a ``warm_fork`` re-warm event +
+    ``pool_warm_refreshes_total``), bounded by ``RDT_WARM_FORK_RETRIES``
+    restarts per manager — long sessions return to fork-fast instead of
+    paying cold spawns forever after one transient prototype death."""
 
     def __init__(self, log_dir: str):
         self._log_dir = log_dir
@@ -282,6 +291,8 @@ class WarmForkManager:
         self._reader: Optional[_LineReader] = None
         self._ready = False
         self._failed = False
+        self._failed_at = 0.0
+        self._refreshes = 0
 
     # ---- lifecycle ----------------------------------------------------------
     def _ensure_started(self) -> None:
@@ -333,6 +344,7 @@ class WarmForkManager:
         go with it, which the supervisor sees as worker death and restarts
         through the cold path."""
         self._failed = True
+        self._failed_at = time.monotonic()
         self._ready = False
         proc, self._proc = self._proc, None
         if proc is not None:
@@ -342,9 +354,21 @@ class WarmForkManager:
                 proc.kill()
             proc.wait(timeout=5.0)
 
+    def _refresh_allowed(self) -> bool:
+        """May a latched-failed plane re-warm a prototype NOW? Bounded by
+        RDT_WARM_FORK_RETRIES restarts per manager, rate-limited by
+        RDT_WARM_REFRESH_COOLDOWN_S since the latch (requests inside the
+        cooldown cold-spawn rather than hammer a crashing prototype)."""
+        if not self._failed:
+            return False
+        if self._refreshes >= max(0, int(knobs.get("RDT_WARM_FORK_RETRIES"))):
+            return False
+        cooldown = max(0.0, float(knobs.get("RDT_WARM_REFRESH_COOLDOWN_S")))
+        return time.monotonic() - self._failed_at >= cooldown
+
     @property
     def available(self) -> bool:
-        return not self._failed
+        return not self._failed or self._refresh_allowed()
 
     # ---- spawn path ---------------------------------------------------------
     def fork(self, env: Dict[str, str], log_path: str,
@@ -361,7 +385,21 @@ class WarmForkManager:
             faults.apply(rule, "pool.fork")
         with self._lock:
             if self._failed:
-                raise WarmForkError("warm-fork plane is latched failed")
+                if not self._refresh_allowed():
+                    raise WarmForkError("warm-fork plane is latched failed")
+                # supervised prototype restart: clear the latch and let
+                # _ensure_started below warm a fresh prototype — fork-fast
+                # returns without a new manager
+                self._refreshes += 1
+                self._failed = False
+                self._ready = False
+                self._proc = None
+                self._reader = None
+                logger.warning("warm-fork plane re-warming prototype "
+                               "(refresh %d)", self._refreshes)
+                metrics.inc("pool_warm_refreshes_total")
+                metrics.record_event("warm_fork", rewarm=True,
+                                     refresh=self._refreshes, key=key)
             if self._proc is not None and self._proc.poll() is not None:
                 logger.warning("warm-fork prototype died (exit %s)",
                                self._proc.returncode)

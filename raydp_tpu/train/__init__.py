@@ -10,7 +10,8 @@ gradient ``psum`` over ICI.
 """
 
 from raydp_tpu.train.estimator import EstimatorInterface, FrameEstimatorInterface
-from raydp_tpu.train.flax_estimator import FlaxEstimator, TrainingResult
+from raydp_tpu.train.flax_estimator import (FlaxEstimator, PipelineModel,
+                                            TrainingResult)
 from raydp_tpu.train.metrics import Metric, build_metrics
 
 from raydp_tpu.train.gbdt_estimator import GBDTEstimator
@@ -20,6 +21,7 @@ __all__ = [
     "FrameEstimatorInterface",
     "FlaxEstimator",
     "GBDTEstimator",
+    "PipelineModel",
     "KerasEstimator",
     "TrainingResult",
     "Metric",

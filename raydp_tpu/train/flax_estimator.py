@@ -197,6 +197,155 @@ def _make_apply(model, takes_train, split_batch, compute_dtype):
     return apply_fn
 
 
+class PipelineModel:
+    """A layer-list model description for pipeline-parallel placement.
+
+    ``layers`` is a sequence of stage-homogeneous Flax modules (identical
+    parameter structure and shapes — the transformer-block case); ``embed``
+    and ``head`` are optional entry/exit modules that run OUTSIDE the
+    pipeline (embed must map the batch inputs to the hidden array the blocks
+    consume). On a mesh with ``stage > 1`` the estimator stacks the per-layer
+    parameter pytrees via
+    :func:`raydp_tpu.parallel.pipeline.stack_stage_params` onto a leading
+    ``stage_stack`` axis (role-driven specs shard it over ``stage``) and runs
+    the blocks through the ``shard_map`` GPipe schedule; on ``stage == 1``
+    meshes the same description trains through a sequential ``vmap`` fallback
+    — one model description, any mesh.
+
+    ``init``/``apply`` mirror the Flax module surface the estimator and the
+    serving bundle consume (``apply`` is the host-side sequential form used
+    by ``predict``/``export_serving`` — row-identical to the pipelined
+    forward). BatchNorm-style mutable collections are not supported in the
+    blocks (``init`` raises: running stats cannot hop stages).
+    """
+
+    def __init__(self, layers, embed=None, head=None):
+        if not layers:
+            raise ValueError("PipelineModel needs at least one layer")
+        self.layers = list(layers)
+        self.embed = embed
+        self.head = head
+
+    def init(self, rng, inputs):
+        import jax
+
+        from raydp_tpu.parallel.pipeline import stack_stage_params
+
+        params: Dict[str, Any] = {}
+        h = inputs
+        if self.embed is not None:
+            rng, k = jax.random.split(rng)
+            v = self.embed.init(k, h)
+            self._reject_mutable(v, "embed")
+            params["embed"] = v["params"]
+            h = self.embed.apply({"params": params["embed"]}, h)
+        layer_params = []
+        for i, layer in enumerate(self.layers):
+            rng, k = jax.random.split(rng)
+            v = layer.init(k, h)
+            self._reject_mutable(v, f"layers[{i}]")
+            layer_params.append(v["params"])
+            h = layer.apply({"params": v["params"]}, h)
+        # jnp.stack raises on shape mismatch — the stage-homogeneity check
+        params["stage_stack"] = stack_stage_params(layer_params)
+        if self.head is not None:
+            rng, k = jax.random.split(rng)
+            v = self.head.init(k, h)
+            self._reject_mutable(v, "head")
+            params["head"] = v["params"]
+        return {"params": params}
+
+    @staticmethod
+    def _reject_mutable(variables, where: str):
+        extra = sorted(set(variables) - {"params"})
+        if extra:
+            raise ValueError(
+                f"PipelineModel {where} carries mutable collections {extra} "
+                f"(e.g. BatchNorm batch_stats): running stats cannot hop "
+                f"pipeline stages — use stat-free blocks (LayerNorm)")
+
+    def apply(self, variables, inputs):
+        """Host/serving forward: the layers applied sequentially from the
+        stacked tree — the exact math of the pipelined forward, one device."""
+        import jax
+
+        p = variables["params"]
+        h = inputs
+        if self.embed is not None:
+            h = self.embed.apply({"params": p["embed"]}, h)
+        stack = p["stage_stack"]
+        n_layers = int(jax.tree.leaves(stack)[0].shape[0])
+        block = self.layers[0]
+        for i in range(n_layers):
+            h = block.apply(
+                {"params": jax.tree.map(lambda a: a[i], stack)}, h)
+        if self.head is not None:
+            h = self.head.apply({"params": p["head"]}, h)
+        return h
+
+
+def _make_pipeline_apply(model: "PipelineModel", split_batch, compute_dtype,
+                         mesh, n_micro: int, seg_modes: Dict[str, str]):
+    """The pipeline twin of :func:`_make_apply`: same
+    ``apply_fn(params, bstats, batch, train) -> (preds_f32, labels, None)``
+    signature, but the forward splits the batch into ``n_micro`` microbatches
+    and marches them through the ``shard_map`` GPipe schedule
+    (:func:`raydp_tpu.parallel.pipeline.pipeline_apply`).
+
+    This is where accumulation and pipeline microbatching UNIFY: the
+    estimator's ``accum_steps`` microbatches ARE the pipeline's microbatches
+    — one ``lax.scan`` of ``n_micro + n_stages - 1`` ticks runs the whole
+    forward, and AD of it is the reverse pipeline, so the train step wraps
+    this forward with ``accum=1`` (a second scan would re-microbatch the
+    microbatches). ``seg_modes`` maps each segment (``embed`` /
+    ``stage_stack`` / ``head``) to its remat mode — the per-role policy
+    resolved against each segment's dominant parameter role.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from raydp_tpu.parallel.pipeline import pipeline_apply
+    from raydp_tpu.parallel.roles import apply_remat
+
+    embed_mod, head_mod, block = model.embed, model.head, model.layers[0]
+
+    def _block_fwd(p, x):
+        return block.apply({"params": p}, x)
+
+    block_fwd = apply_remat(_block_fwd, seg_modes.get("stage_stack", "none"))
+    embed_fwd = head_fwd = None
+    if embed_mod is not None:
+        embed_fwd = apply_remat(
+            lambda p, x: embed_mod.apply({"params": p}, x),
+            seg_modes.get("embed", "none"))
+    if head_mod is not None:
+        head_fwd = apply_remat(
+            lambda p, x: head_mod.apply({"params": p}, x),
+            seg_modes.get("head", "none"))
+
+    def apply_fn(params, bstats, batch, train: bool):
+        del bstats, train  # pipeline blocks are stat-free and mode-free
+        inputs, labels = split_batch(batch)
+        inputs = _cast_floating(inputs, compute_dtype)
+        h = embed_fwd(params["embed"], inputs) if embed_fwd is not None \
+            else inputs
+        rows = int(h.shape[0])
+        if rows % n_micro:
+            raise ValueError(
+                f"pipeline microbatching: accum_steps={n_micro} does not "
+                f"divide the batch dimension {rows} — pad-and-mask the tail "
+                f"(RDT_TRAIN_PAD_TAIL) or drop it (drop_last=True)")
+        h_micro = h.reshape((n_micro, rows // n_micro) + h.shape[1:])
+        out = pipeline_apply(block_fwd, params["stage_stack"], h_micro, mesh)
+        h2 = out.reshape((rows,) + out.shape[2:])
+        preds = head_fwd(params["head"], h2) if head_fwd is not None else h2
+        if preds.ndim == labels.ndim + 1 and preds.shape[-1] == 1:
+            preds = preds.squeeze(-1)
+        return preds.astype(jnp.float32), labels, None
+
+    return apply_fn
+
+
 def _make_train_step(apply_fn, loss_fn, metrics, accum: int, remat_mode: str,
                      mb_shardings=None):
     """Build the jitted train-step body shared by ``fit`` and
@@ -390,9 +539,12 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         #: only one microbatch's activations are live — peak activation
         #: bytes drop ~k×. Must divide batch_size.
         self.accum_steps = accum_steps
-        #: rematerialization policy for the train-step forward
-        #: ('none' | 'dots' | 'full'; None = the RDT_TRAIN_REMAT knob) —
-        #: jax.checkpoint placement per parallel/roles.py remat_policy
+        #: rematerialization policy for the train-step forward: a global
+        #: mode ('none' | 'dots' | 'full' — the default policy) or a
+        #: per-role 'role=mode,...' map over the param roles
+        #: ('embedding=none,kernel=dots,default=full'); None = the
+        #: RDT_TRAIN_REMAT knob. jax.checkpoint placement per
+        #: parallel/roles.py parse_remat_policy / remat_policy
         self.remat = remat
         #: shard declared sequence dims (dim 1 of ndim >= 2 batch leaves)
         #: over the mesh's ``seq`` axis (None = auto: on whenever the mesh
@@ -413,15 +565,64 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
                 f"accum_steps={k} must divide batch_size={self.batch_size}")
         return k
 
-    def _resolve_remat(self) -> str:
-        """The effective remat mode for THIS fit, validated against the
-        REMAT_MODES vocabulary (remat_policy raises on an unknown mode)."""
-        from raydp_tpu.parallel.roles import remat_policy
+    def _resolve_remat(self) -> Dict[str, str]:
+        """The effective remat POLICY for THIS fit: a role→mode map parsed
+        (and validated, eagerly — long before any compile) by
+        :func:`raydp_tpu.parallel.roles.parse_remat_policy`. A bare mode
+        string (the pre-r20 global form) parses to ``{"default": mode}`` —
+        the global mode IS the default policy, so old specs behave
+        identically; ``"embedding=none,kernel=dots"`` picks per parameter
+        role the way the param specs are picked."""
+        from raydp_tpu.parallel.roles import parse_remat_policy
 
-        mode = (self.remat if self.remat is not None
+        spec = (self.remat if self.remat is not None
                 else str(knobs.get("RDT_TRAIN_REMAT"))).lower()
-        remat_policy(mode)  # validate eagerly: fail before any compile
-        return mode
+        return parse_remat_policy(spec)
+
+    def _make_forward(self, model, mesh, takes_train, params):
+        """Build THIS fit's forward + the train-step knobs around it — ONE
+        source shared by ``fit`` and ``partial_fit`` so the two cannot drift.
+
+        Returns ``(apply_fn, step_accum, step_remat, n_micro, n_stages)``:
+        the forward with :func:`_make_apply`'s signature, the accumulation
+        factor and remat mode ``_make_train_step`` should apply AROUND it,
+        and the pipeline geometry. For a :class:`PipelineModel` the forward
+        is the GPipe schedule with the resolved ``accum_steps`` as its
+        microbatch count — so ``step_accum`` is 1 and ``step_remat`` is
+        ``none`` (microbatching and remat both live INSIDE the pipelined
+        forward, per segment); a monolithic model keeps the scan-around-
+        the-forward shape, its mode resolved from the params' dominant
+        role under the per-role policy."""
+        from raydp_tpu.parallel.mesh import stage_extent
+        from raydp_tpu.parallel.roles import (remat_mode_for_role,
+                                              segment_role)
+
+        accum = self._resolve_accum()
+        policy = self._resolve_remat()
+        n_stages = stage_extent(mesh)
+        if isinstance(model, PipelineModel):
+            n_layers = len(model.layers)
+            if n_stages > 1 and n_layers % n_stages:
+                raise ValueError(
+                    f"PipelineModel has {n_layers} layers; the mesh's "
+                    f"stage={n_stages} must divide them (each stage applies "
+                    f"a contiguous run of layers)")
+            seg_modes = {
+                name: remat_mode_for_role(policy, segment_role(sub))
+                for name, sub in params.items()}
+            papply = _make_pipeline_apply(model, self._split_batch,
+                                          self.compute_dtype, mesh, accum,
+                                          seg_modes)
+            return papply, 1, "none", accum, n_stages
+        if n_stages > 1:
+            raise ValueError(
+                f"mesh has stage={n_stages} but the model is not a "
+                f"PipelineModel: stage-stacked placement needs the "
+                f"layer-list description (raydp_tpu.train.PipelineModel)")
+        mode = remat_mode_for_role(policy, segment_role(params))
+        apply_fn = _make_apply(model, takes_train, self._split_batch,
+                               self.compute_dtype)
+        return apply_fn, accum, mode, accum, 1
 
     def _use_seq(self, mesh) -> bool:
         """Does THIS fit extend batch shardings over the mesh's seq axis?
@@ -479,11 +680,17 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         # pad-and-mask rule, decided HERE for every feed below so train and
         # eval cannot disagree: under a >1 data extent a ragged tail pads to
         # a full (shardable) batch and carries a validity mask instead of
-        # silently dropping rows. RDT_TRAIN_PAD_TAIL=0 — or a custom loss
-        # with no mask kwarg — restores the drop behavior.
-        from raydp_tpu.parallel.mesh import data_axes
+        # silently dropping rows. A >1 STAGE extent needs the same rule for
+        # a different reason: the pipelined forward reshapes every batch
+        # into accum_steps microbatches, so a ragged tail must pad to the
+        # (divisible) full batch — its pad rows mask out of the loss exactly
+        # like dp pad rows. RDT_TRAIN_PAD_TAIL=0 — or a custom loss with no
+        # mask kwarg — restores the drop behavior.
+        from raydp_tpu.parallel.mesh import data_axes, stage_extent
         dp_total = int(np.prod([mesh.shape[a] for a in data_axes(mesh)]))
-        pad_tail = (dp_total > 1 and bool(knobs.get("RDT_TRAIN_PAD_TAIL"))
+        stage_total = stage_extent(mesh)
+        pad_tail = ((dp_total > 1 or stage_total > 1)
+                    and bool(knobs.get("RDT_TRAIN_PAD_TAIL"))
                     and _loss_takes_mask(self._loss))
         use_seq = self._use_seq(mesh)
 
@@ -504,10 +711,11 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         eval_feed = eval_cache = None
         eval_tail_ok = False
         if evaluate_ds is not None:
-            # the ragged final batch: fine as-is under a size-1 data extent,
-            # pad-and-masked under a >1 one (dropped only when padding is
-            # opted out — the pre-PR-16 behavior)
-            eval_tail_ok = dp_total == 1 or pad_tail
+            # the ragged final batch: fine as-is under a size-1 data extent
+            # (and no pipeline — a stage>1 forward cannot reshape a ragged
+            # batch), pad-and-masked under a >1 one (dropped only when
+            # padding is opted out — the pre-PR-16 behavior)
+            eval_tail_ok = (dp_total == 1 and stage_total == 1) or pad_tail
             # eval goes resident alongside the train set: the whole eval
             # pass becomes one scan dispatch (+ one for the ragged tail)
             # instead of one dispatch per batch, every epoch. The budget is
@@ -521,7 +729,7 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
             else:
                 eval_feed = DeviceFeed(evaluate_ds, self.batch_size, columns,
                                        mesh=mesh, shuffle=False,
-                                       drop_remainder=dp_total > 1,
+                                       drop_remainder=not eval_tail_ok,
                                        pad_remainder=pad_tail,
                                        prefetch_to_device=self.prefetch_to_device,
                                        seq=use_seq)
@@ -598,14 +806,18 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         seq_sharding = batch_sharding(mesh, seq=True) \
             if self._use_seq(mesh) else None
 
-        # the activation-side plane: accumulation factor and remat policy,
-        # resolved per fit (constructor args win over the PER_ACTION knobs)
-        accum = self._resolve_accum()
-        remat_mode = self._resolve_remat()
+        # the activation-side plane: accumulation factor, remat policy and
+        # (on a stage>1 mesh) the GPipe schedule, resolved per fit
+        # (constructor args win over the PER_ACTION knobs). In pipeline mode
+        # the accum microbatches ARE the pipeline microbatches — one scan —
+        # so the step wraps the forward with accum=1/remat "none" (both live
+        # inside the pipelined forward, per segment).
+        _apply, step_accum, step_remat, accum, n_stages = self._make_forward(
+            model, mesh, takes_train, state.params)
+        pipelined = n_stages > 1 or isinstance(model, PipelineModel)
         rdt_metrics.set_gauge("train_accum_steps", accum)
-
-        _apply = _make_apply(model, takes_train, self._split_batch,
-                             self.compute_dtype)
+        if pipelined:
+            rdt_metrics.set_gauge("train_pipeline_stages", n_stages)
 
         # Loss accumulators are threaded THROUGH the jitted steps rather than
         # collected as a host-side list: under a multi-process gang, an eager
@@ -614,21 +826,22 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
         # same order — a rank that is one step behind deadlocks the gang. With
         # in-jit accumulation the only host reads are float() of replicated
         # scalars at epoch end (also one fewer host sync single-process).
-        train_step = _make_train_step(_apply, loss_fn, metrics, accum,
-                                      remat_mode,
+        train_step = _make_train_step(_apply, loss_fn, metrics, step_accum,
+                                      step_remat,
                                       mb_shardings=(b_sharding, seq_sharding))
 
         # publish the compiled step's peak temp (activation) bytes when the
         # activation plane is engaged — the residency number accumulation/
-        # remat drive down, read off XLA's memory_analysis at first dispatch.
-        # Best-effort: some backends lack the analysis, and telemetry must
-        # never fail (or slow an un-engaged) fit.
-        measured = [accum <= 1 and remat_mode == "none"]
+        # remat/pipelining drive down, read off XLA's memory_analysis at
+        # first dispatch. Best-effort: some backends lack the analysis, and
+        # telemetry must never fail (or slow an un-engaged) fit.
+        measured = [accum <= 1 and step_remat == "none" and not pipelined]
+        _compile_span = "train:pipeline" if pipelined else "train:accum"
 
         def _note_activation(fn, *args):
             measured[0] = True
             try:
-                with profiler.trace("train:accum", "training"):
+                with profiler.trace(_compile_span, "training"):
                     mem = fn.lower(*args).compile().memory_analysis()
                 temp = getattr(mem, "temp_size_in_bytes", None)
                 if temp is not None:
@@ -1019,31 +1232,36 @@ class FlaxEstimator(EstimatorInterface, FrameEstimatorInterface):
             state, param_sharding_rules(mesh, self.param_rules)(state))
 
         # the SAME step body as fit()'s (one source): the online path gets
-        # gradient accumulation and remat for free, and the two cannot drift
-        accum = self._resolve_accum()
+        # gradient accumulation, remat AND pipeline placement for free, and
+        # the two cannot drift
+        _apply, step_accum, step_remat, accum, n_stages = self._make_forward(
+            model, mesh, takes_train, state.params)
         from raydp_tpu import metrics as rdt_metrics
         rdt_metrics.set_gauge("train_accum_steps", accum)
+        if isinstance(model, PipelineModel):
+            rdt_metrics.set_gauge("train_pipeline_stages", n_stages)
         train_step = _make_train_step(
-            _make_apply(model, takes_train, self._split_batch,
-                        self.compute_dtype),
-            loss_fn, metrics, accum, self._resolve_remat(),
+            _apply, loss_fn, metrics, step_accum, step_remat,
             mb_shardings=(batch_sharding(mesh),
                           batch_sharding(mesh, seq=True)
                           if self._use_seq(mesh) else None))
 
         dp_total = int(np.prod([mesh.shape[a] for a in data_axes(mesh)]))
-        # the ragged micro-batch tail under a >1 data extent: pad-and-mask
-        # like fit()'s feeds (an online epoch is often SMALLER than one
-        # batch — dropping its tail silently skipped whole micro-batches);
-        # RDT_TRAIN_PAD_TAIL=0 or a mask-blind custom loss restores drop
-        pad_tail = (dp_total > 1 and bool(knobs.get("RDT_TRAIN_PAD_TAIL"))
+        # the ragged micro-batch tail under a >1 data extent (or a >1 stage
+        # extent — the pipelined forward cannot reshape a ragged batch):
+        # pad-and-mask like fit()'s feeds (an online epoch is often SMALLER
+        # than one batch — dropping its tail silently skipped whole
+        # micro-batches); RDT_TRAIN_PAD_TAIL=0 or a mask-blind custom loss
+        # restores drop
+        pad_tail = ((dp_total > 1 or n_stages > 1)
+                    and bool(knobs.get("RDT_TRAIN_PAD_TAIL"))
                     and _loss_takes_mask(self._loss))
         return {
             "mesh": mesh,
             "columns": columns,
             "state": state,
             "jit_train": jax.jit(train_step, donate_argnums=(0, 3)),
-            "drop_last": dp_total > 1 and not pad_tail,
+            "drop_last": (dp_total > 1 or n_stages > 1) and not pad_tail,
             "pad_tail": pad_tail,
             "seq": self._use_seq(mesh),
             "history": [],

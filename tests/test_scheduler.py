@@ -475,3 +475,70 @@ def test_locality_stream_bucket_sees_tiers(monkeypatch):
     # at full spilled weight the raw byte count would win instead
     monkeypatch.setenv("RDT_LOCALITY_SPILLED_WEIGHT", "1.0")
     assert engine._locality([[E._StreamBucket(rec, 0)]]) == ["eA"]
+
+
+# ==== remote residency tier scoring (ISSUE 20, ROADMAP 4b) ===================
+
+
+def _gravity_fixture(monkeypatch, residency):
+    """Two-host pool + engine with a stubbed bulk residency RPC."""
+    pool = ExecutorPool([StubExecutor(name="eA"), StubExecutor(name="eB")],
+                        hosts_by_name={"eA": "hostA", "eB": "hostB"})
+    engine = E.Engine(pool)
+
+    class _Client:
+        def residency(self, refs):
+            return residency
+
+    monkeypatch.setattr(E, "get_client", lambda: _Client())
+    return pool, engine
+
+
+def test_remote_weight_keeps_holder_ranking(monkeypatch):
+    """Remote crediting is ranking-NEUTRAL among byte-holders: each host
+    scores ``(1-r)*local + r*total`` — monotone in its local bytes — so
+    for any r < 1 the shm holder still beats a bigger spilled pile and a
+    non-holder never outranks a holder."""
+    pool, engine = _gravity_fixture(monkeypatch, {
+        ("a" * 32): ("hostA", "shm"),
+        ("b" * 32): ("hostB", "spilled")})
+    ra = ObjectRef(id="a" * 32, size=1000)
+    rb = ObjectRef(id="b" * 32, size=1600)   # spilled at 0.5 -> 800
+    for r in ("0.25", "0.9"):
+        monkeypatch.setenv("RDT_LOCALITY_REMOTE_WEIGHT", r)
+        assert engine._locality([[ra, rb]]) == ["eA"], r
+        # sole holder still wins over the credited non-holder
+        assert engine._locality([[ra]]) == ["eA"], r
+
+
+def test_remote_weight_gives_live_nonholder_a_fallback(monkeypatch):
+    """The point of the knob: when the gravity host is draining, a LIVE
+    non-holder carries a real remote-discounted score, so pick_weighted
+    returns a ranked fallback instead of no preference — and remote
+    weight 0 restores the holder-only behavior (no fallback)."""
+    pool, engine = _gravity_fixture(monkeypatch, {
+        ("a" * 32): ("hostA", "shm")})
+    ra = ObjectRef(id="a" * 32, size=1000)
+    assert pool.begin_drain("eA")
+    monkeypatch.setenv("RDT_LOCALITY_REMOTE_WEIGHT", "0.25")
+    assert engine._locality([[ra]]) == ["eB"], \
+        "live non-holder must become the ranked fallback"
+    monkeypatch.setenv("RDT_LOCALITY_REMOTE_WEIGHT", "0")
+    assert engine._locality([[ra]]) == [None], \
+        "weight 0 must restore holder-only scoring"
+
+
+def test_remote_weight_one_is_distance_blind(monkeypatch):
+    """r=1 credits every live host the task's full bytes: all hosts tie
+    and rotate — the distance-blind ceiling of the knob (values above 1
+    clamp, so preference can never invert toward non-holders)."""
+    pool, engine = _gravity_fixture(monkeypatch, {
+        ("a" * 32): ("hostA", "shm")})
+    ra = ObjectRef(id="a" * 32, size=1000)
+    monkeypatch.setenv("RDT_LOCALITY_REMOTE_WEIGHT", "1.0")
+    task = [ra]
+    assert engine._locality([task, task, task, task]) \
+        == ["eA", "eB", "eA", "eB"]
+    # clamp: 5.0 behaves as 1.0, not as an inverted preference
+    monkeypatch.setenv("RDT_LOCALITY_REMOTE_WEIGHT", "5.0")
+    assert engine._locality([task, task]) == ["eA", "eB"]

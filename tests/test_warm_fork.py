@@ -114,6 +114,69 @@ def test_broken_prototype_degrades_loudly(fast_prototype, monkeypatch,
     ref[0].stop()
 
 
+
+def _refreshes() -> int:
+    return int(metrics.snapshot()["counters"]
+               .get("pool_warm_refreshes_total", {}).get("", 0))
+
+def test_latched_plane_refreshes_and_forks_again(fast_prototype, monkeypatch,
+                                                 tmp_path):
+    """Supervised prototype restart (ROADMAP 4c): a latched-failed plane
+    re-warms a fresh prototype on the next fork — latch → refresh →
+    fork-fast-again — bounded by RDT_WARM_FORK_RETRIES, with the re-warm
+    event and pool_warm_refreshes_total recording each restart."""
+    monkeypatch.setenv("RDT_WARM_REFRESH_COOLDOWN_S", "0")
+    monkeypatch.setenv("RDT_WARM_FORK_RETRIES", "2")
+    monkeypatch.setenv("RDT_WARM_FORK_WAIT_S", "2")
+    real_exe = warm_fork.sys.executable
+    monkeypatch.setattr(warm_fork.sys, "executable", "/bin/false")
+    mgr = warm_fork.WarmForkManager(str(tmp_path))
+    try:
+        with pytest.raises(warm_fork.WarmForkError):
+            mgr.fork({}, str(tmp_path / "w0.log"), key="w0")
+        assert mgr._failed, "broken prototype must latch the plane"
+        # cooldown=0 + retries remaining: the plane advertises availability
+        assert mgr.available, "refresh budget must keep the plane available"
+        # heal the prototype binary; the next fork re-warms and succeeds
+        monkeypatch.setattr(warm_fork.sys, "executable", real_exe)
+        monkeypatch.setenv("RDT_WARM_FORK_WAIT_S", "10")
+        child = mgr.fork({}, str(tmp_path / "w1.log"), key="w1")
+        assert child.wait(timeout=15.0) == 1  # bootstrap-with-no-env exit
+        assert not mgr._failed
+        assert _refreshes() == 1
+        evs = [e for e in metrics.events() if e["kind"] == "warm_fork"]
+        assert any(e.get("rewarm") and e.get("refresh") == 1 for e in evs)
+        # fork-fast-again: further forks ride the refreshed prototype
+        c2 = mgr.fork({}, str(tmp_path / "w2.log"), key="w2")
+        assert c2.wait(timeout=15.0) == 1
+    finally:
+        mgr.stop()
+
+
+def test_refresh_budget_exhausts_to_permanent_latch(fast_prototype,
+                                                    monkeypatch, tmp_path):
+    """Exceeding RDT_WARM_FORK_RETRIES leaves the latch permanent: a plane
+    that keeps crashing stops re-warming and every later fork cold-spawns."""
+    monkeypatch.setenv("RDT_WARM_REFRESH_COOLDOWN_S", "0")
+    monkeypatch.setenv("RDT_WARM_FORK_RETRIES", "1")
+    monkeypatch.setenv("RDT_WARM_FORK_WAIT_S", "2")
+    monkeypatch.setattr(warm_fork.sys, "executable", "/bin/false")
+    mgr = warm_fork.WarmForkManager(str(tmp_path))
+    try:
+        with pytest.raises(warm_fork.WarmForkError):
+            mgr.fork({}, str(tmp_path / "w0.log"), key="w0")
+        # the one refresh attempt burns against the still-broken binary
+        with pytest.raises(warm_fork.WarmForkError):
+            mgr.fork({}, str(tmp_path / "w1.log"), key="w1")
+        assert _refreshes() == 1
+        assert not mgr.available, "exhausted refresh budget must latch"
+        with pytest.raises(warm_fork.WarmForkError):
+            mgr.fork({}, str(tmp_path / "w2.log"), key="w2")
+        assert _refreshes() == 1
+    finally:
+        mgr.stop()
+
+
 def test_fork_raise_fault_degrades_to_cold(fast_prototype, tmp_path):
     """The ``raise`` action at ``pool.fork`` models a transient fork-path
     fault: warm_spawn degrades to None and the caller cold-spawns, without
